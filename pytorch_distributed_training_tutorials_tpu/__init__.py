@@ -1,0 +1,36 @@
+"""TPU-native distributed-training framework and tutorial suite.
+
+A brand-new JAX/XLA/pjit implementation of the capabilities exercised by the
+reference tutorial suite ``duoan/pytorch_distributed_training_tutorials``
+(see /root/repo/SURVEY.md for the full structural analysis):
+
+- process-group bootstrap / rendezvous   -> :mod:`.parallel.distributed`
+- device-mesh construction               -> :mod:`.parallel.mesh`
+- sharded data loading (DistributedSampler semantics) -> :mod:`.data`
+- SPMD data-parallel Trainer (DP + DDP twin)          -> :mod:`.train`
+- models (MLP, ResNet-18/50) and utilities            -> :mod:`.models`
+- benchmark harness                                   -> :mod:`.bench`
+
+Design stance (SURVEY.md section 7): the reference's three distinct parallelism
+APIs (nn.DataParallel, DistributedDataParallel, manual ``.to(device)`` splits)
+collapse into one mesh + sharding abstraction with three configurations. The
+observable semantics of the reference are preserved: per-device batch-size flag
+meaning, steps-per-epoch math, epoch-seeded reshuffle, rank-0 logging, the
+2-stage split, and the benchmark comparison.
+"""
+
+__version__ = "0.1.0"
+
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (  # noqa: F401
+    create_mesh,
+    DATA_AXIS,
+    MODEL_AXIS,
+    STAGE_AXIS,
+    SEQ_AXIS,
+)
+from pytorch_distributed_training_tutorials_tpu.parallel.distributed import (  # noqa: F401
+    init,
+    shutdown,
+    process_index,
+    process_count,
+)

@@ -1,0 +1,6 @@
+"""Benchmark harness (twin of reference C17)."""
+
+from pytorch_distributed_training_tutorials_tpu.bench.harness import (  # noqa: F401
+    benchmark,
+    BenchResult,
+)

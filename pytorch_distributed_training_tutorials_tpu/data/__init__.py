@@ -1,0 +1,21 @@
+"""Sharded input pipeline: the TPU twin of the reference's L2 data layer.
+
+Reference surface (SURVEY.md C6/C7): map-style ``Dataset``, ``DataLoader`` with
+``sampler=DistributedSampler(ds)`` for per-rank disjoint shards padded to equal
+length, and ``sampler.set_epoch(epoch)`` for epoch-seeded reshuffle
+(reference ``ddp_gpus.py:56-79``, ``:45``).
+"""
+
+from pytorch_distributed_training_tutorials_tpu.data.sampler import (  # noqa: F401
+    DistributedSampler,
+)
+from pytorch_distributed_training_tutorials_tpu.data.datasets import (  # noqa: F401
+    ArrayDataset,
+    synthetic_regression,
+    random_dataset,
+    mnist,
+    cifar10,
+)
+from pytorch_distributed_training_tutorials_tpu.data.loader import (  # noqa: F401
+    ShardedLoader,
+)

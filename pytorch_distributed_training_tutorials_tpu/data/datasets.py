@@ -1,0 +1,167 @@
+"""Datasets: synthetic twins of the reference's, plus MNIST/CIFAR-10 loaders.
+
+The reference materializes whole datasets in host memory up front
+(``MyTrainDataset`` builds all 2,048 samples in ``__init__``, reference
+``ddp_gpus.py:56-62``). We keep that map-style, fully-materialized model — it
+is the right one for TPU input pipelines at tutorial scale: host numpy arrays,
+batch-gathered and ``device_put`` straight to the mesh.
+
+BASELINE.json upgrades the toy workloads to ResNet-18 on MNIST / CIFAR-10, so
+real loaders are included. They read the standard binary formats from a local
+directory (``DATA_DIR`` env var, default ``~/.cache/tpu_ddp_data``); when the
+files are absent (this build environment has no network egress) they fall back
+to a *deterministic, clearly-labeled* synthetic surrogate with identical
+shapes/dtypes/cardinalities so every code path stays runnable.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from dataclasses import dataclass
+
+import numpy as np
+
+DATA_DIR = os.environ.get("DATA_DIR", os.path.expanduser("~/.cache/tpu_ddp_data"))
+
+
+@dataclass
+class ArrayDataset:
+    """A fully-materialized map-style dataset: parallel numpy arrays.
+
+    Twin of the reference's map-style ``Dataset.__len__/__getitem__`` surface
+    (``ddp_gpus.py:63-67``), but batch-gather oriented: ``gather(indices)``
+    returns the batch in one vectorized fancy-index instead of a Python loop
+    over ``__getitem__`` — the host-side work per step is one numpy gather.
+    """
+
+    arrays: tuple[np.ndarray, ...]
+    synthetic: bool = False  # True when this is a no-network surrogate
+
+    def __post_init__(self):
+        n = len(self.arrays[0])
+        for a in self.arrays[1:]:
+            if len(a) != n:
+                raise ValueError("all arrays must share dim 0")
+
+    def __len__(self) -> int:
+        return len(self.arrays[0])
+
+    def __getitem__(self, i: int):
+        return tuple(a[i] for a in self.arrays)
+
+    def gather(self, indices: np.ndarray) -> tuple[np.ndarray, ...]:
+        return tuple(a[indices] for a in self.arrays)
+
+
+def synthetic_regression(
+    size: int = 2048, in_dim: int = 20, out_dim: int = 1, seed: int = 0
+) -> ArrayDataset:
+    """Twin of ``MyTrainDataset``: ``size`` samples of ``(rand(20), rand(1))``.
+
+    Reference ``ddp_gpus.py:56-62`` (duplicated at
+    ``ddp_gpus_torchrun.py:52-63`` and ``02.ddp_toy_example.ipynb`` cell 5).
+    Uniform [0,1) features and targets, materialized up front.
+    """
+    rng = np.random.Generator(np.random.PCG64(seed))
+    x = rng.random((size, in_dim), dtype=np.float32)
+    y = rng.random((size, out_dim), dtype=np.float32)
+    return ArrayDataset((x, y))
+
+
+def random_dataset(size: int = 32, length: int = 1024, seed: int = 0) -> ArrayDataset:
+    """Twin of 01's ``RandomDataset(32, 1024)``: ``length`` samples of randn(size).
+
+    Reference ``01.data_parallel.ipynb`` cell 6 (line 118).
+    """
+    rng = np.random.Generator(np.random.PCG64(seed))
+    x = rng.standard_normal((length, size)).astype(np.float32)
+    return ArrayDataset((x,))
+
+
+def _synthetic_images(
+    n: int, shape: tuple[int, ...], num_classes: int, seed: int
+) -> ArrayDataset:
+    """Deterministic class-separable surrogate for an image dataset.
+
+    Each class gets a fixed random template; samples are template + noise, so a
+    real model can actually learn (loss decreases, accuracy rises) — this keeps
+    convergence tests meaningful without network access.
+    """
+    rng = np.random.Generator(np.random.PCG64(seed))
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    templates = rng.standard_normal((num_classes, *shape)).astype(np.float32)
+    images = templates[labels] * 0.5 + 0.5 * rng.standard_normal(
+        (n, *shape)
+    ).astype(np.float32)
+    return ArrayDataset((images, labels), synthetic=True)
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+def mnist(split: str = "train", data_dir: str | None = None) -> ArrayDataset:
+    """MNIST as (N, 28, 28, 1) float32 in [0,1] + int32 labels (NHWC for TPU).
+
+    Reads the standard idx(.gz) files if present under ``data_dir``; otherwise
+    returns a deterministic synthetic surrogate with identical shape/classes
+    (``.synthetic`` is set so callers/benchmarks can report it honestly).
+    """
+    data_dir = data_dir or DATA_DIR
+    prefix = "train" if split == "train" else "t10k"
+    for ext in ("", ".gz"):
+        img_p = os.path.join(data_dir, f"{prefix}-images-idx3-ubyte{ext}")
+        lbl_p = os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte{ext}")
+        if os.path.exists(img_p) and os.path.exists(lbl_p):
+            images = _read_idx(img_p).astype(np.float32)[..., None] / 255.0
+            labels = _read_idx(lbl_p).astype(np.int32)
+            return ArrayDataset((images, labels))
+    n = 60000 if split == "train" else 10000
+    # Fixed per-split constants: hash() is interpreter-randomized and would
+    # desync the surrogate across processes/runs.
+    return _synthetic_images(n, (28, 28, 1), 10, seed=1 if split == "train" else 2)
+
+
+def cifar10(split: str = "train", data_dir: str | None = None) -> ArrayDataset:
+    """CIFAR-10 as (N, 32, 32, 3) float32 in [0,1] + int32 labels (NHWC).
+
+    Reads the python-pickle batches from ``cifar-10-batches-py`` (or the
+    ``.tar.gz``) if present; otherwise a deterministic synthetic surrogate.
+    """
+    data_dir = data_dir or DATA_DIR
+    batch_dir = os.path.join(data_dir, "cifar-10-batches-py")
+    tar_path = os.path.join(data_dir, "cifar-10-python.tar.gz")
+    if not os.path.isdir(batch_dir) and os.path.exists(tar_path):
+        with tarfile.open(tar_path) as t:
+            t.extractall(data_dir)
+    if os.path.isdir(batch_dir):
+        names = (
+            [f"data_batch_{i}" for i in range(1, 6)]
+            if split == "train"
+            else ["test_batch"]
+        )
+        xs, ys = [], []
+        for name in names:
+            with open(os.path.join(batch_dir, name), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+        images = (
+            np.concatenate(xs)
+            .reshape(-1, 3, 32, 32)
+            .transpose(0, 2, 3, 1)
+            .astype(np.float32)
+            / 255.0
+        )
+        return ArrayDataset((images, np.asarray(ys, dtype=np.int32)))
+    n = 50000 if split == "train" else 10000
+    return _synthetic_images(n, (32, 32, 3), 10, seed=3 if split == "train" else 4)

@@ -1,0 +1,84 @@
+"""DistributedSampler-exact index sharding.
+
+The reference shards its dataset with ``torch.utils.data.DistributedSampler``
+(reference ``ddp_gpus.py:78``) and reshuffles per epoch via
+``sampler.set_epoch(epoch)`` (``ddp_gpus.py:45``). Under SPMD this padding is a
+*correctness* requirement, not a convenience: every rank must run the same
+number of steps or collectives deadlock (SURVEY.md section 7, hard part 1).
+
+Semantics replicated exactly (validated against torch's sampler in
+``tests/test_sampler.py``):
+
+- ``num_samples = ceil(len(ds) / world)`` (or ``floor`` with ``drop_last`` when
+  the dataset doesn't divide evenly), ``total = num_samples * world``.
+- shuffle: a permutation of ``range(len(ds))`` seeded by ``seed + epoch``;
+  without shuffle, ``arange``.
+- padding: indices are extended by wrapping from the beginning until ``total``
+  (or truncated to ``total`` with ``drop_last``).
+- rank r takes the strided slice ``indices[r::world]`` — disjoint across ranks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Per-rank disjoint, equal-length index shards with epoch reshuffle."""
+
+    def __init__(
+        self,
+        dataset_size: int,
+        num_replicas: int,
+        rank: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if not 0 <= rank < num_replicas:
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.dataset_size = dataset_size
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+        if drop_last and dataset_size % num_replicas:
+            self.num_samples = dataset_size // num_replicas
+        else:
+            self.num_samples = -(-dataset_size // num_replicas)  # ceil
+        self.total_size = self.num_samples * num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shard permutation; twin of reference ``ddp_gpus.py:45``."""
+        self.epoch = epoch
+
+    def _global_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.Generator(np.random.PCG64(self.seed + self.epoch))
+            indices = rng.permutation(self.dataset_size)
+        else:
+            indices = np.arange(self.dataset_size)
+        if not self.drop_last:
+            pad = self.total_size - len(indices)
+            if pad > 0:
+                # Wrap-around padding, repeating the prefix as many times as
+                # needed (matters when world size exceeds dataset size).
+                reps = -(-pad // len(indices))
+                indices = np.concatenate([indices] + [indices] * reps)[: self.total_size]
+        else:
+            indices = indices[: self.total_size]
+        return indices
+
+    def local_indices(self) -> np.ndarray:
+        """This rank's shard: the strided slice ``indices[rank::world]``."""
+        return self._global_indices()[self.rank :: self.num_replicas]
+
+    def __iter__(self):
+        return iter(self.local_indices().tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples

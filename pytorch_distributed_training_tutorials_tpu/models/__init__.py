@@ -1,0 +1,28 @@
+"""Models: twins of every model the reference constructs, plus BASELINE's.
+
+- :class:`LinearRegressor` — ``nn.Linear(20, 1)`` (reference ``ddp_gpus.py:81``)
+- :class:`SampleModel` — ``Linear(32, 2)`` with observable per-device batch
+  split (reference ``01.data_parallel.ipynb`` cell 9)
+- :class:`MLP` — generic 2-layer MLP (BASELINE config "02.ddp_toy_example")
+- :class:`ToyModel` — the 2-stage ``Linear(10000,10) -> ReLU -> Linear(10,5)``
+  model-parallel toy (reference ``03.model_parallel.ipynb`` cell 7)
+- :func:`resnet18` / :func:`resnet50` — torchvision-architecture ResNets
+  (reference ``03.model_parallel.ipynb`` cells 15/18; BASELINE ResNet-18)
+- :func:`model_size` — parameter-count util (reference cell 20)
+"""
+
+from pytorch_distributed_training_tutorials_tpu.models.mlp import (  # noqa: F401
+    LinearRegressor,
+    SampleModel,
+    MLP,
+    ToyModel,
+)
+from pytorch_distributed_training_tutorials_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+)
+from pytorch_distributed_training_tutorials_tpu.models.utils import (  # noqa: F401
+    model_size,
+)

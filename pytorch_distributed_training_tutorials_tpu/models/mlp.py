@@ -1,0 +1,100 @@
+"""Linear / MLP models: twins of the reference's toy models.
+
+All modules take NHWC/feature-last inputs and a ``dtype`` for bf16 compute
+(params stay float32; casts happen at the matmul, the TPU mixed-precision
+idiom).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class LinearRegressor(nn.Module):
+    """Twin of ``torch.nn.Linear(20, 1)`` (reference ``ddp_gpus.py:81``).
+
+    The exact model of the DDP scripts' workload: 20 features -> 1 output.
+    """
+
+    in_dim: int = 20
+    out_dim: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.out_dim, dtype=self.dtype)(x)
+
+
+class SampleModel(nn.Module):
+    """Twin of 01's ``SampleModel`` (reference ``01.data_parallel.ipynb`` cell 9).
+
+    ``Linear(32, 2)`` whose forward *prints its input shape* — the lesson's way
+    of proving the 4-way batch scatter (cell 16's ``Input shape: [8, 32]``
+    stream). Under SPMD the traced shape is the *global* logical shape (that is
+    the lesson: there is no per-replica program), so ``debug_shapes=True``
+    prints that; the per-device block split is observed on the input array
+    itself via :func:`..ops.debug.per_shard_shapes`.
+    """
+
+    in_dim: int = 32
+    out_dim: int = 2
+    debug_shapes: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.debug_shapes:
+            jax.debug.print(
+                "SampleModel forward: global (not per-shard) input shape {s}",
+                s=jnp.asarray(x.shape),
+            )
+        return nn.Dense(self.out_dim, dtype=self.dtype)(x)
+
+
+class MLP(nn.Module):
+    """Generic MLP (BASELINE config: "2-layer MLP on synthetic tensors")."""
+
+    features: Sequence[int] = (128, 1)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+class ToyModel(nn.Module):
+    """Twin of 03's 2-device ``ToyModel`` (reference
+    ``03.model_parallel.ipynb:440-450``): ``Linear(10000, 10) -> ReLU ->
+    Linear(10, 5)``.
+
+    The reference places ``net1`` on cuda:0 and ``net2`` on cuda:1 with an
+    explicit ``x.to("cuda:1")`` hop in forward. Here the module is
+    placement-free; a pipeline strategy consumes the declared cut between
+    ``stage0`` and ``stage1``.
+    """
+
+    in_dim: int = 10000
+    hidden: int = 10
+    out_dim: int = 5
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        self.net1 = nn.Dense(self.hidden, dtype=self.dtype)
+        self.net2 = nn.Dense(self.out_dim, dtype=self.dtype)
+
+    def stage0(self, x):
+        return nn.relu(self.net1(x))
+
+    def stage1(self, x):
+        return self.net2(x)
+
+    def __call__(self, x):
+        return self.stage1(self.stage0(x))

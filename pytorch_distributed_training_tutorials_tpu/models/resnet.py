@@ -1,0 +1,167 @@
+"""ResNets (flax.linen), architecture-exact to the reference's torchvision ones.
+
+The reference builds torchvision ``resnet50()`` and a 2-stage split subclass
+``ModelParallelResNet50`` with ``seq1 = conv1..layer2`` on device 0 and
+``seq2 = layer3..avgpool`` + ``fc`` on device 1 (reference
+``03.model_parallel.ipynb:807-834``), checking that the parameter count
+25,557,032 is invariant under the split (cells 20/22, ``:866,:897``).
+
+This implementation reproduces the architecture (and therefore the exact
+parameter count — pinned in ``tests/test_models.py``) and exposes the same
+2-stage cut as ``stage0``/``stage1`` methods for the pipeline strategies,
+instead of hardcoding device placements into the
+model. Layout is NHWC (the TPU-native convolution layout), compute dtype is
+configurable for bf16 MXU matmuls, params stay float32.
+
+``stem="cifar"`` (3x3 conv, no maxpool) is provided for the 28x28/32x32
+BASELINE workloads (ResNet-18 on MNIST / CIFAR-10), where an ImageNet stem
+would immediately collapse the feature map.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from functools import partial
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity shortcut (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3))(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), (self.strides, self.strides))(
+                residual
+            )
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1(x4) bottleneck (ResNet-50/101/152)."""
+
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.filters * self.expansion, (1, 1))(y)
+        y = norm()(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters * self.expansion, (1, 1),
+                (self.strides, self.strides),
+            )(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """torchvision-architecture ResNet with a declared 2-stage cut.
+
+    ``split_after`` names the layer group (1-4) after which the pipeline cut
+    falls; the reference cuts after layer2 (``03.model_parallel.ipynb:812-825``).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: Callable
+    num_classes: int = 1000
+    num_filters: int = 64
+    stem: str = "imagenet"  # "imagenet" (7x7/s2 + maxpool) or "cifar" (3x3/s1)
+    split_after: int = 2
+    dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        if self.stem == "imagenet":
+            self.conv1 = conv(
+                self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)]
+            )
+        else:
+            self.conv1 = conv(self.num_filters, (3, 3), (1, 1))
+        self.bn1 = nn.BatchNorm(momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        blocks = []
+        for i, size in enumerate(self.stage_sizes):
+            group = []
+            for j in range(size):
+                strides = 2 if i > 0 and j == 0 else 1
+                group.append(
+                    self.block_cls(
+                        filters=self.num_filters * 2**i,
+                        strides=strides,
+                        dtype=self.dtype,
+                    )
+                )
+            blocks.append(group)
+        self.layer_groups = blocks
+        self.fc = nn.Dense(self.num_classes, dtype=self.dtype)
+
+    def _stem(self, x, train: bool):
+        x = self.conv1(x)
+        x = nn.relu(self.bn1(x, use_running_average=not train))
+        if self.stem == "imagenet":
+            x = nn.max_pool(
+                x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)]
+            )
+        return x
+
+    def stage0(self, x, train: bool = True):
+        """conv1..layer<split_after> — the reference's ``seq1`` (cuda:0 half)."""
+        x = self._stem(x, train)
+        for group in self.layer_groups[: self.split_after]:
+            for block in group:
+                x = block(x, train)
+        return x
+
+    def stage1(self, x, train: bool = True):
+        """layer<split_after+1>..avgpool + fc — the reference's ``seq2`` + fc."""
+        for group in self.layer_groups[self.split_after :]:
+            for block in group:
+                x = block(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return self.fc(x)
+
+    def __call__(self, x, train: bool = True):
+        return self.stage1(self.stage0(x, train), train)
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock, **kw)
+
+
+def resnet34(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck, **kw)

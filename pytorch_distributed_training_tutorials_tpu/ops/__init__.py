@@ -1,0 +1,13 @@
+"""Ops: Pallas TPU kernels and debug/observability helpers.
+
+The reference has no custom kernels (SURVEY.md section 2: zero native
+components) — its hot ops are vendored cuDNN/cuBLAS. Here the hot path is
+XLA-compiled; Pallas kernels live in this package where fusion beyond XLA's
+pays off, and :mod:`.debug` holds the sharding-observability twins of the
+tutorials' shape prints.
+"""
+
+from pytorch_distributed_training_tutorials_tpu.ops.debug import (  # noqa: F401
+    per_shard_shapes,
+    describe_sharding,
+)

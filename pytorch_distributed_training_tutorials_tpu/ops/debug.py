@@ -1,0 +1,29 @@
+"""Sharding observability: the tutorials' shape-print lessons, TPU-style.
+
+Lesson 01 proves the DataParallel scatter by printing ``Input shape: [8, 32]``
+from each of 4 replicas (reference ``01.data_parallel.ipynb`` cells 9/16).
+The SPMD twin: inspect the per-shard block of a sharded ``jax.Array``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def per_shard_shapes(x: jax.Array) -> list[tuple]:
+    """Shapes of each addressable shard of ``x``.
+
+    For a batch of 32 sharded over 4 devices this returns four ``(8, ...)``
+    entries — the observable twin of lesson 01's ``Input shape: [8, 32]``
+    prints (reference ``01.data_parallel.ipynb`` cell 16 stream output).
+    """
+    return [s.data.shape for s in x.addressable_shards]
+
+
+def describe_sharding(x: jax.Array) -> str:
+    """One-line device/shape audit of an array, like 03's param audit
+    (reference ``03.model_parallel.ipynb`` cell 4)."""
+    shards = ", ".join(
+        f"{s.device}:{s.data.shape}" for s in x.addressable_shards
+    )
+    return f"global {x.shape} {x.dtype} -> [{shards}]"

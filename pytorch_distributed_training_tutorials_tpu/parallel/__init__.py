@@ -1,0 +1,24 @@
+"""Parallelism: mesh construction, distributed bootstrap, strategy configs.
+
+TPU-native twin of the reference's L1 (process group) and L3 (parallelism
+strategy) layers — see SURVEY.md sections 1-2. One mesh + sharding abstraction
+replaces ``nn.DataParallel`` / ``DistributedDataParallel`` / manual device
+placement.
+"""
+
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (  # noqa: F401
+    create_mesh,
+    DATA_AXIS,
+    MODEL_AXIS,
+    STAGE_AXIS,
+    SEQ_AXIS,
+)
+from pytorch_distributed_training_tutorials_tpu.parallel.distributed import (  # noqa: F401
+    init,
+    shutdown,
+    process_index,
+    process_count,
+)
+from pytorch_distributed_training_tutorials_tpu.parallel.data_parallel import (  # noqa: F401
+    DataParallel,
+)

@@ -1,0 +1,47 @@
+"""Data-parallel strategy: replicated params, batch-sharded data.
+
+This single sharding configuration is the TPU twin of *both* reference
+data-parallel APIs (SURVEY.md section 7):
+
+- ``nn.DataParallel`` (reference ``01.data_parallel.ipynb`` cell 14): its
+  per-step replicate/scatter/parallel_apply/gather collapses into one compiled
+  SPMD program — params live replicated (no per-step broadcast), the batch is
+  sharded on the ``data`` axis, outputs stay sharded.
+- ``DistributedDataParallel`` (reference ``ddp_gpus.py:32``): the param
+  broadcast at construction becomes the replicated placement; the bucketed
+  NCCL grad allreduce in ``backward()`` (``ddp_gpus.py:38``) becomes the
+  allreduce XLA inserts — and overlaps with the backward — when it propagates
+  the replicated-param sharding through ``jax.grad``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (
+    DATA_AXIS,
+    create_mesh,
+)
+
+
+class DataParallel:
+    """Sharding recipe for data parallelism over one mesh axis."""
+
+    def __init__(self, mesh: Mesh | None = None, axis: str = DATA_AXIS):
+        self.mesh = mesh if mesh is not None else create_mesh()
+        self.axis = axis
+        self.param_sharding = NamedSharding(self.mesh, PartitionSpec())
+        self.batch_sharding = NamedSharding(self.mesh, PartitionSpec(axis))
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.shape.get(self.axis, 1)
+
+    def shard_state(self, state):
+        """Place a train state replicated on the mesh (the 'DDP broadcast')."""
+        return jax.device_put(state, self.param_sharding)
+
+    def shard_batch(self, batch):
+        """Shard a host batch along dim 0 (the 'DataParallel scatter')."""
+        return jax.device_put(batch, self.batch_sharding)

@@ -1,0 +1,109 @@
+"""Named device-mesh construction.
+
+TPU-native replacement for the reference's process-group + device-pinning layer
+(``ddp_setup`` at reference ``ddp_gpus.py:12-17`` and
+``ddp_gpus_torchrun.py:12-14``). Where torch pins one CUDA device per process
+and builds an NCCL communicator, on TPU a single SPMD program runs over a
+:class:`jax.sharding.Mesh` with named axes; XLA compiles the collectives over
+ICI/DCN.
+
+Axis-name conventions (reserved up front so later strategies don't force a
+redesign — SURVEY.md sections 2 and 5.7):
+
+- ``data``  — data parallelism (the reference's DP/DDP lessons)
+- ``model`` — tensor parallelism (absent in the reference; reserved)
+- ``stage`` — pipeline stages (the reference's 2-stage model-parallel lesson)
+- ``seq``   — sequence/context parallelism (absent in the reference; reserved)
+"""
+
+from __future__ import annotations
+
+import math
+from collections.abc import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+STAGE_AXIS = "stage"
+SEQ_AXIS = "seq"
+
+
+def create_mesh(
+    axes: dict[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named :class:`jax.sharding.Mesh` over ``devices``.
+
+    ``axes`` maps axis name -> size. At most one axis may be ``-1``, meaning
+    "all remaining devices" (like a reshape wildcard). With no arguments this
+    returns a pure data-parallel mesh over every device — the twin of the
+    reference's ``world_size = torch.cuda.device_count()`` default
+    (``ddp_gpus.py:104``).
+
+    Examples::
+
+        create_mesh()                          # {'data': all devices}
+        create_mesh({'data': -1, 'model': 2})  # 2-way tensor parallel inside DP
+        create_mesh({'stage': 2})              # the 03-notebook 2-stage split
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+
+    if axes is None:
+        axes = {DATA_AXIS: n}
+    axes = dict(axes)
+
+    wildcard = [k for k, v in axes.items() if v == -1]
+    if len(wildcard) > 1:
+        raise ValueError(f"at most one axis may be -1, got {wildcard}")
+    fixed = math.prod(v for v in axes.values() if v != -1)
+    if wildcard:
+        if n % fixed:
+            raise ValueError(
+                f"cannot infer axis {wildcard[0]!r}: {n} devices not divisible "
+                f"by the product of fixed axes ({fixed})"
+            )
+        axes[wildcard[0]] = n // fixed
+    total = math.prod(axes.values())
+    if total > n:
+        raise ValueError(
+            f"mesh axes {axes} require {total} devices but {n} are available"
+        )
+    # A smaller explicit mesh takes a device prefix — the twin of running a
+    # world smaller than torch.cuda.device_count().
+    devices = devices[:total]
+
+    # Axis order follows the user's dict order; put 'data' outermost on
+    # multi-slice pods so it maps to DCN and inner axes ride ICI.
+    names = tuple(axes.keys())
+    shape = tuple(axes[k] for k in names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names=names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding that replicates an array on every device of ``mesh``."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding that splits dim 0 (the batch) across ``axis``.
+
+    This is the single annotation that replaces the reference's entire
+    scatter machinery (``nn.DataParallel``'s 32 -> 4 x 8 split,
+    reference ``01.data_parallel.ipynb:478``, and ``DistributedSampler``'s
+    per-rank shard, ``ddp_gpus.py:78``): XLA splits dim 0 over the ``data``
+    axis and inserts the gradient allreduce during ``grad``.
+    """
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    """Size of ``axis`` in ``mesh`` (1 if the axis does not exist)."""
+    return mesh.shape.get(axis, 1)

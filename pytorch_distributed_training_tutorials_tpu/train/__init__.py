@@ -1,0 +1,9 @@
+"""Training loop: the TPU twin of the reference's L4 Trainer layer."""
+
+from pytorch_distributed_training_tutorials_tpu.train.trainer import (  # noqa: F401
+    Trainer,
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_eval_step,
+)

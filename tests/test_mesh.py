@@ -1,0 +1,50 @@
+"""Mesh construction and sharding helpers."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from pytorch_distributed_training_tutorials_tpu.parallel import mesh as M
+
+
+def test_default_mesh_is_data_parallel_over_all_devices():
+    m = M.create_mesh()
+    assert m.shape == {"data": 8}
+
+
+def test_wildcard_axis():
+    m = M.create_mesh({"data": -1, "model": 2})
+    assert m.shape == {"data": 4, "model": 2}
+
+
+def test_submesh_prefix():
+    # Smaller explicit meshes take a device prefix (world < device_count).
+    m = M.create_mesh({"data": 3})
+    assert m.shape == {"data": 3}
+
+
+def test_bad_axis_product_raises():
+    with pytest.raises(ValueError):
+        M.create_mesh({"data": 16})  # oversubscribed
+    with pytest.raises(ValueError):
+        M.create_mesh({"data": -1, "model": 3})  # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        M.create_mesh({"data": -1, "model": -1})  # two wildcards
+
+
+def test_batch_sharding_splits_dim0():
+    m = M.create_mesh()
+    x = np.arange(32 * 4, dtype=np.float32).reshape(32, 4)
+    xs = jax.device_put(x, M.batch_sharding(m))
+    shapes = [s.data.shape for s in xs.addressable_shards]
+    assert shapes == [(4, 4)] * 8
+    np.testing.assert_array_equal(np.asarray(xs), x)
+
+
+def test_replicated_sharding():
+    m = M.create_mesh()
+    x = np.ones((3, 3), np.float32)
+    xr = jax.device_put(x, M.replicated(m))
+    assert all(s.data.shape == (3, 3) for s in xr.addressable_shards)
+    assert xr.sharding.spec == PartitionSpec()

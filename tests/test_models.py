@@ -1,0 +1,89 @@
+"""Models: shapes, param counts (incl. the ResNet-50 25,557,032 invariant)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.models import (
+    MLP,
+    LinearRegressor,
+    SampleModel,
+    ToyModel,
+    model_size,
+    resnet18,
+    resnet50,
+)
+
+
+def _init(model, shape, **kw):
+    return model.init(jax.random.PRNGKey(0), jnp.zeros(shape, jnp.float32), **kw)
+
+
+def test_linear_regressor_shapes_and_size():
+    m = LinearRegressor()
+    v = _init(m, (4, 20))
+    out = m.apply(v, jnp.ones((4, 20)))
+    assert out.shape == (4, 1)
+    assert model_size(v["params"]) == 20 * 1 + 1  # torch nn.Linear(20,1)
+
+
+def test_sample_model():
+    m = SampleModel()
+    v = _init(m, (8, 32))
+    assert m.apply(v, jnp.ones((8, 32))).shape == (8, 2)
+    assert model_size(v["params"]) == 32 * 2 + 2
+
+
+def test_toy_model_stage_composition():
+    m = ToyModel()
+    v = _init(m, (2, 10000))
+    full = m.apply(v, jnp.ones((2, 10000)))
+    assert full.shape == (2, 5)
+    # stage0 |> stage1 == __call__
+    a = m.apply(v, jnp.ones((2, 10000)), method=m.stage0)
+    out = m.apply(v, a, method=m.stage1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(out), rtol=1e-6)
+
+
+def test_mlp():
+    m = MLP(features=(128, 10))
+    v = _init(m, (4, 20))
+    assert m.apply(v, jnp.ones((4, 20))).shape == (4, 10)
+
+
+@pytest.mark.slow
+def test_resnet50_param_count_reference_invariant():
+    # Reference: 25,557,032 params for torchvision resnet50
+    # (03.model_parallel.ipynb cells 20/22), invariant under the 2-stage split.
+    m = resnet50()
+    v = _init(m, (1, 64, 64, 3), train=False)
+    assert model_size(v["params"]) == 25_557_032
+
+
+def test_resnet18_param_count_matches_torchvision_formula():
+    # torchvision resnet18 with 1000 classes has 11,689,512 params.
+    m = resnet18()
+    v = _init(m, (1, 64, 64, 3), train=False)
+    assert model_size(v["params"]) == 11_689_512
+
+
+def test_resnet18_cifar_stem_forward_and_stats():
+    m = resnet18(num_classes=10, stem="cifar")
+    v = _init(m, (2, 32, 32, 3), train=False)
+    assert "batch_stats" in v
+    out, updates = m.apply(
+        v, jnp.ones((2, 32, 32, 3)), train=True, mutable=["batch_stats"]
+    )
+    assert out.shape == (2, 10)
+    assert "batch_stats" in updates
+
+
+def test_resnet_stage_composition_matches_full_forward():
+    m = resnet18(num_classes=10, stem="cifar")
+    v = _init(m, (2, 32, 32, 3), train=False)
+    x = jnp.linspace(0, 1, 2 * 32 * 32 * 3).reshape(2, 32, 32, 3)
+    full = m.apply(v, x, train=False)
+    a = m.apply(v, x, False, method=m.stage0)
+    out = m.apply(v, a, False, method=m.stage1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(out), rtol=1e-5)
