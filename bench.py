@@ -1,0 +1,79 @@
+"""Headline benchmark: images/sec/chip, ResNet-18 / MNIST, data-parallel.
+
+The BASELINE.json north-star (``BASELINE.json:2``): data-parallel ResNet-18 on
+MNIST, reported per chip. The reference publishes no numbers
+(``BASELINE.json:13``), so ``vs_baseline`` is reported against
+``BASELINE_IMAGES_PER_SEC_PER_CHIP`` below — set from this repo's first
+recorded TPU run so later rounds measure improvement against round 1.
+
+Prints exactly one JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+"""
+
+from __future__ import annotations
+
+import json
+
+# Round-1 first honest measurement on one TPU v5e chip (bf16 compute,
+# slope-timed to cancel the axon tunnel's async dispatch + roundtrip latency).
+# Later rounds divide by this to show the trend.
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 46400.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_training_tutorials_tpu.data import (
+        ShardedLoader,
+        mnist,
+    )
+    from pytorch_distributed_training_tutorials_tpu.models import resnet18
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+    from pytorch_distributed_training_tutorials_tpu.train import (
+        Trainer,
+    )
+
+    mesh = create_mesh()
+    n_chips = mesh.devices.size
+    per_device_batch = 256
+
+    ds = mnist("train")
+    loader = ShardedLoader(ds, per_device_batch, mesh, seed=0)
+    model = resnet18(num_classes=10, stem="cifar", dtype=jnp.bfloat16)
+    trainer = Trainer(
+        model, loader, optax.sgd(0.05, momentum=0.9), loss="cross_entropy"
+    )
+
+    batch = next(iter(loader))
+
+    def run(k: int) -> None:
+        # k chained steps ending in a host fetch (slope_time contract)
+        last = None
+        for _ in range(k):
+            trainer.state, last = trainer.train_step(trainer.state, batch)
+        float(last["loss"])
+
+    from pytorch_distributed_training_tutorials_tpu.bench.harness import slope_time
+
+    sec_per_step = slope_time(run, n1=5, n2=25, warmup=3)
+    images_per_sec = loader.global_batch / sec_per_step
+    per_chip = images_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "images/sec/chip (ResNet-18 MNIST, data-parallel train)",
+                "value": round(per_chip, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(
+                    per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -8,6 +8,8 @@ reference tutorial suite ``duoan/pytorch_distributed_training_tutorials``
 - device-mesh construction               -> :mod:`.parallel.mesh`
 - sharded data loading (DistributedSampler semantics) -> :mod:`.data`
 - SPMD data-parallel Trainer (DP + DDP twin)          -> :mod:`.train`
+- manual + pipeline model parallelism                 -> :mod:`.parallel.pipeline`
+- auto placement / sharded checkpoint restore         -> :mod:`.parallel.auto`
 - models (MLP, ResNet-18/50) and utilities            -> :mod:`.models`
 - benchmark harness                                   -> :mod:`.bench`
 

@@ -98,3 +98,8 @@ class ToyModel(nn.Module):
 
     def __call__(self, x):
         return self.stage1(self.stage0(x))
+
+    def stage_partition(self, name: str) -> int:
+        """Param-key -> stage rule: net1 on stage 0, net2 on stage 1
+        (the reference's cuda:0 / cuda:1 assignment)."""
+        return 0 if name == "net1" else 1

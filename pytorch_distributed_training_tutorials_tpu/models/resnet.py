@@ -154,6 +154,17 @@ class ResNet(nn.Module):
     def __call__(self, x, train: bool = True):
         return self.stage1(self.stage0(x, train), train)
 
+    def stage_partition(self, name: str) -> int:
+        """Param-key -> stage rule matching the reference's seq1/seq2 cut
+        (stem + layer groups < split_after on stage 0; rest + fc on stage 1)."""
+        if name in ("conv1", "bn1"):
+            return 0
+        if name == "fc":
+            return 1
+        if name.startswith("layer_groups_"):
+            return 0 if int(name.split("_")[2]) < self.split_after else 1
+        raise ValueError(f"unknown param key {name!r}")
+
 
 def resnet18(**kw) -> ResNet:
     return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock, **kw)

@@ -22,3 +22,10 @@ from pytorch_distributed_training_tutorials_tpu.parallel.distributed import (  #
 from pytorch_distributed_training_tutorials_tpu.parallel.data_parallel import (  # noqa: F401
     DataParallel,
 )
+from pytorch_distributed_training_tutorials_tpu.parallel.pipeline import (  # noqa: F401
+    ManualPipeline,
+    partition_variables,
+)
+
+# .auto (orbax checkpointing / auto placement) is imported lazily by users —
+# orbax is a heavyweight import and not needed on the hot path.
