@@ -1,0 +1,29 @@
+"""Launch layer: the reference's L5 (CLI + process launchers), TPU-native.
+
+The reference exposes two launch contracts whose delta is *where topology
+comes from* (SURVEY.md C9/C10):
+
+- **spawn** (reference ``ddp_gpus.py:97-105``): the parent counts devices and
+  forks one worker per device with ``mp.spawn``, passing the rank explicitly.
+- **torchrun** (reference ``ddp_gpus_torchrun.py:92-99``): an external agent
+  does rendezvous and injects ``RANK``/``WORLD_SIZE``/... env vars; the script
+  reads them.
+
+On TPU the unit of process parallelism is the *host*, not the chip — one SPMD
+process drives all local chips — so:
+
+- :func:`spawn` forks N local processes that form a jax.distributed world
+  (the mp.spawn twin; on real pods it models one-process-per-host, and in
+  tests it runs multi-"host" CPU worlds with gloo collectives on one machine,
+  the reference's "multi-node without a cluster" posture, SURVEY.md section 4).
+- ``python -m pytorch_distributed_training_tutorials_tpu.launch.train_ddp_env``
+  is the torchrun-twin entrypoint: topology comes entirely from env
+  (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``, or a
+  TPU pod's runtime metadata) — run the same command on every host.
+"""
+
+from pytorch_distributed_training_tutorials_tpu.launch._spawn import (  # noqa: F401
+    coordinator_for_spawn,
+    pick_unused_port,
+    spawn,
+)

@@ -1,0 +1,145 @@
+"""Multi-process spawn launcher: the ``mp.spawn`` twin.
+
+Twin of the reference's launcher (``ddp_gpus.py:104-105``): fork ``nprocs``
+workers, inject the rank as the target's first argument, join, and surface
+child failures. The TPU-native differences:
+
+- each worker is a full jax.distributed *process* (one per host on a real
+  pod); the worker body calls :func:`..parallel.distributed.init` itself —
+  either explicitly (spawn contract) or from env (torchrun contract,
+  ``env_contract=True`` here plays the torchrun agent and injects
+  ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``).
+- ``platform="cpu"`` runs the world on CPU devices with gloo collectives —
+  the hardware-free multi-process harness (SURVEY.md section 4's
+  "multi-node testing without a cluster").
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from collections.abc import Callable, Sequence
+
+DEFAULT_JOIN_TIMEOUT_S = 300.0
+
+
+def pick_unused_port() -> int:
+    """An OS-assigned free TCP port for the coordinator rendezvous."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(
+    rank: int,
+    nprocs: int,
+    coordinator: str,
+    platform: str | None,
+    env_contract: bool,
+    devices_per_process: int,
+) -> dict[str, str | None]:
+    """Env delta for one child. ``None`` value = remove the variable."""
+    env: dict[str, str | None] = {}
+    if platform is not None:
+        env["JAX_PLATFORMS"] = platform
+        if platform == "cpu":
+            # This build's sitecustomize registers a TPU backend whenever
+            # PALLAS_AXON_POOL_IPS is set; a CPU world must not claim it.
+            env["PALLAS_AXON_POOL_IPS"] = None
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = " ".join(
+                f for f in flags.split() if "host_platform_device_count" not in f
+            )
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{devices_per_process}"
+            ).strip()
+    if env_contract:
+        # Play the torchrun agent: rendezvous + env injection
+        # (reference 02.ddp_toy_example.ipynb cells 11-12).
+        env["JAX_COORDINATOR_ADDRESS"] = coordinator
+        env["JAX_NUM_PROCESSES"] = str(nprocs)
+        env["JAX_PROCESS_ID"] = str(rank)
+    return env
+
+
+def spawn(
+    target: Callable,
+    nprocs: int,
+    args: Sequence = (),
+    *,
+    coordinator: str | None = None,
+    platform: str | None = None,
+    env_contract: bool = False,
+    devices_per_process: int = 1,
+    join_timeout_s: float = DEFAULT_JOIN_TIMEOUT_S,
+) -> None:
+    """Fork ``nprocs`` workers running ``target(rank, *args)``; join all.
+
+    Twin of ``mp.spawn(main, args=..., nprocs=world_size)``
+    (reference ``ddp_gpus.py:105``): the rank is injected as argument 0.
+    ``target`` must be a module-level (picklable) callable; it is responsible
+    for calling :func:`..parallel.distributed.init` — with explicit
+    ``(coordinator, nprocs, rank)`` for the spawn contract, or bare ``init()``
+    with ``env_contract=True`` for the torchrun contract.
+
+    Raises ``RuntimeError`` naming the failed ranks if any child exits
+    non-zero (the reference inherits this from mp.spawn's error propagation).
+    """
+    if nprocs < 1:
+        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    coordinator = coordinator or f"localhost:{pick_unused_port()}"
+    ctx = mp.get_context("spawn")
+    procs: list[mp.Process] = []
+    saved: dict[str, str | None] = {}
+    try:
+        for rank in range(nprocs):
+            # Children inherit os.environ at start(); stage each child's env
+            # delta, then restore the parent's view.
+            delta = _worker_env(
+                rank, nprocs, coordinator, platform, env_contract,
+                devices_per_process,
+            )
+            for k, v in delta.items():
+                if k not in saved:
+                    saved[k] = os.environ.get(k)
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            p = ctx.Process(
+                target=target, args=(rank, *args), name=f"spawn-rank{rank}"
+            )
+            p.start()
+            procs.append(p)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    failed: list[tuple[int, int | None]] = []
+    for rank, p in enumerate(procs):
+        p.join(join_timeout_s)
+        if p.is_alive():
+            p.terminate()
+            p.join(10)
+            failed.append((rank, None))
+        elif p.exitcode != 0:
+            failed.append((rank, p.exitcode))
+    if failed:
+        detail = ", ".join(
+            f"rank {r}: {'timeout' if c is None else f'exit {c}'}"
+            for r, c in failed
+        )
+        raise RuntimeError(f"spawn: {len(failed)}/{nprocs} workers failed ({detail})")
+
+
+def coordinator_for_spawn(port: int | None = None) -> str:
+    """The spawn contract's rendezvous endpoint (twin of the reference's
+    hardcoded ``MASTER_ADDR=localhost, MASTER_PORT=12345``,
+    ``ddp_gpus.py:13-14``) — but with an OS-assigned port by default, since
+    a hardcoded port is exactly what makes the reference flaky to re-run."""
+    return f"localhost:{port if port is not None else pick_unused_port()}"

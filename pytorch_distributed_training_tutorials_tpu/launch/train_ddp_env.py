@@ -1,0 +1,66 @@
+"""Env-contract DDP training CLI: twin of reference ``ddp_gpus_torchrun.py``.
+
+The torchrun lesson (SURVEY.md C10, reference ``ddp_gpus_torchrun.py:92-99``):
+the script owns *no* topology — an external agent does rendezvous and injects
+it via environment. Here the contract is ``JAX_COORDINATOR_ADDRESS`` /
+``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` (read by
+:func:`..parallel.distributed.init`), or nothing at all on a real TPU pod,
+where ``jax.distributed.initialize`` autodetects topology from the runtime
+metadata — the pod *is* the elastic agent. Run the same command on every
+host::
+
+    # single host (the bare-`torchrun` demo, Steps 64):
+    python -m pytorch_distributed_training_tutorials_tpu.launch.train_ddp_env
+
+    # N-process world, driven entirely by env (the --nproc-per-node demo):
+    JAX_COORDINATOR_ADDRESS=host0:12355 JAX_NUM_PROCESSES=4 JAX_PROCESS_ID=$i \
+        python -m pytorch_distributed_training_tutorials_tpu.launch.train_ddp_env
+"""
+
+from __future__ import annotations
+
+import optax
+
+from pytorch_distributed_training_tutorials_tpu.data import (
+    ShardedLoader,
+    synthetic_regression,
+)
+from pytorch_distributed_training_tutorials_tpu.launch.train_ddp import (
+    DATASET_SIZE,
+    LEARNING_RATE,
+    build_parser,
+)
+from pytorch_distributed_training_tutorials_tpu.models import LinearRegressor
+from pytorch_distributed_training_tutorials_tpu.parallel import distributed
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+from pytorch_distributed_training_tutorials_tpu.train import Trainer
+
+
+def main(max_epochs: int, batch_size: int, loss: str = "mse") -> None:
+    """Twin of reference ``main(max_epochs, batch_size)``
+    (``ddp_gpus_torchrun.py:65-88``): no rank/world arguments anywhere —
+    topology is discovered, not passed."""
+    distributed.init()  # env-driven / autodetect (the torchrun seam)
+    mesh = create_mesh()
+    dataset = synthetic_regression(DATASET_SIZE)
+    loader = ShardedLoader(dataset, batch_size, mesh)
+    trainer = Trainer(
+        LinearRegressor(), loader, optax.sgd(LEARNING_RATE), loss=loss
+    )
+    trainer.train(max_epochs)
+    distributed.shutdown()
+
+
+def env_worker(rank: int, max_epochs: int, batch_size: int) -> None:
+    """Spawn-compatible wrapper for tests: the launcher plays the torchrun
+    agent (env injection); the worker body never sees its rank — it calls
+    the rank-free :func:`main`, proving the env contract end to end."""
+    del rank  # discovered from env inside main(), by design
+    main(max_epochs, batch_size)
+
+
+if __name__ == "__main__":
+    p = build_parser()
+    # topology flags are meaningless here — the env owns them
+    args = p.parse_args()
+    main(args.max_epochs, args.batch_size, loss=args.loss)

@@ -28,16 +28,14 @@ import orbax.checkpoint as ocp
 def save_checkpoint(path: str | os.PathLike, tree) -> None:
     """Write a pytree (params / full train-state) as a sharded checkpoint.
 
-    Overwrites an existing checkpoint at ``path`` (orbax refuses pre-existing
-    destinations, so it is removed first). Each host writes only its
-    addressable shards, the multi-host twin of the reference's 33-shard
-    checkpoint layout.
+    Overwrites an existing checkpoint at ``path`` (``force=True`` — orbax
+    removes the old directory on the primary host with its own cross-host
+    synchronization). Each host writes only its addressable shards, the
+    multi-host twin of the reference's 33-shard checkpoint layout.
     """
     path = os.path.abspath(path)
-    if os.path.exists(path) and jax.process_index() == 0:
-        shutil.rmtree(path)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, tree)
+        ckptr.save(path, tree, force=True)
 
 
 def restore_checkpoint(path: str | os.PathLike, like=None):

@@ -76,6 +76,15 @@ def init(
         # peers; device "pinning" is implicit in the TPU topology.
         return
 
+    # The env contract: jax reads JAX_COORDINATOR_ADDRESS natively, but has
+    # no JAX_NUM_PROCESSES/JAX_PROCESS_ID autodetection outside managed
+    # clusters (SLURM/MPI/Cloud TPU metadata) — so this layer provides it,
+    # completing the torchrun-style env seam (RANK/WORLD_SIZE twin).
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
     kwargs: dict = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
