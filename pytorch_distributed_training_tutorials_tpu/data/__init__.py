@@ -12,6 +12,7 @@ from pytorch_distributed_training_tutorials_tpu.data.sampler import (  # noqa: F
 from pytorch_distributed_training_tutorials_tpu.data.datasets import (  # noqa: F401
     ArrayDataset,
     synthetic_regression,
+    synthetic_lm,
     random_dataset,
     mnist,
     cifar10,

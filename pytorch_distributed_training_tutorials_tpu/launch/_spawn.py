@@ -113,6 +113,14 @@ def spawn(
             )
             p.start()
             procs.append(p)
+    except BaseException:
+        # A failed start() mid-loop would leave earlier ranks blocked at the
+        # rendezvous forever (their world can never reach nprocs) — reap them.
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+            p.join(10)
+        raise
     finally:
         for k, v in saved.items():
             if v is None:

@@ -69,19 +69,21 @@ def main(
     distributed.shutdown()
 
 
-def build_parser() -> argparse.ArgumentParser:
+def build_parser(launch_flags: bool = True) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="TPU-native DDP training (spawn flavor)")
     # the reference's exact two flags (ddp_gpus.py:98-102)
     p.add_argument("--max_epochs", type=int, default=10,
                    help="Total epochs to train the model")
     p.add_argument("--batch_size", type=int, default=32,
                    help="Input batch size on each device (default: 32)")
-    p.add_argument("--nprocs", type=int, default=1,
-                   help="Processes to fork (1 = pure SPMD over local chips; "
-                        ">1 = multi-process world, the mp.spawn twin)")
-    p.add_argument("--platform", type=str, default=None,
-                   help="Force a JAX platform in workers (e.g. 'cpu' for the "
-                        "hardware-free multi-process harness)")
+    if launch_flags:
+        p.add_argument("--nprocs", type=int, default=1,
+                       help="Processes to fork (1 = pure SPMD over local "
+                            "chips; >1 = multi-process world, the mp.spawn "
+                            "twin)")
+        p.add_argument("--platform", type=str, default=None,
+                       help="Force a JAX platform in workers (e.g. 'cpu' for "
+                            "the hardware-free multi-process harness)")
     p.add_argument("--loss", choices=("mse", "cross_entropy"), default="mse")
     return p
 

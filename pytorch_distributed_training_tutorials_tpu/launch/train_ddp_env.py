@@ -60,7 +60,6 @@ def env_worker(rank: int, max_epochs: int, batch_size: int) -> None:
 
 
 if __name__ == "__main__":
-    p = build_parser()
-    # topology flags are meaningless here — the env owns them
-    args = p.parse_args()
+    # no launch flags: topology is owned by the environment, by design
+    args = build_parser(launch_flags=False).parse_args()
     main(args.max_epochs, args.batch_size, loss=args.loss)

@@ -23,6 +23,11 @@ from pytorch_distributed_training_tutorials_tpu.models.resnet import (  # noqa: 
     resnet34,
     resnet50,
 )
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+    TP_RULES,
+)
 from pytorch_distributed_training_tutorials_tpu.models.utils import (  # noqa: F401
     model_size,
 )

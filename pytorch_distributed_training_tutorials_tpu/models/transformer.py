@@ -1,0 +1,209 @@
+"""Decoder-only transformer LM, TPU-first.
+
+The reference's only transformer is the vendored Llama-7B it *loads* for the
+``device_map="auto"`` placement demo — never run on a prompt
+(``03.model_parallel.ipynb`` cell 2; SURVEY.md C13, section 5.7). This module
+supplies the model family the framework needs first-class: a Llama-style
+decoder (RMSNorm, rotary positions, SwiGLU) written for XLA:
+
+- static shapes, no data-dependent Python control flow; optional
+  ``nn.scan`` over layers (``scan_layers=True``) for O(1) compile time at
+  depth, and optional ``nn.remat`` (``remat=True``) to trade FLOPs for HBM.
+- bf16-friendly: params stay float32, compute casts to ``cfg.dtype`` at the
+  matmuls; softmax and RMS statistics in float32.
+- the attention inner loop is pluggable (``attention_fn``) so sequence-
+  parallel ring attention (:mod:`..parallel.ring_attention`) slots in without
+  touching the module.
+- placement-free: tensor-parallel sharding lives in :data:`TP_RULES`
+  (param-path regex -> PartitionSpec), consumed by
+  :class:`..parallel.tensor_parallel.TensorParallel` — the Megatron-style
+  column/row split expressed as GSPMD annotations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int | None = None  # default 4 * d_model
+    max_seq_len: int = 512
+    dtype: jnp.dtype = jnp.float32
+    rope_theta: float = 10000.0
+    scan_layers: bool = False
+    remat: bool = False
+    # attention_fn(q, k, v) -> out, all (B, S, H, D), causal semantics.
+    # None = dense causal softmax attention on-device.
+    attention_fn: Callable | None = None
+
+    @property
+    def ff_dim(self) -> int:
+        return self.d_ff if self.d_ff is not None else 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square LayerNorm (no mean subtraction), stats in float32."""
+
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + self.eps)
+        return (y * scale).astype(x.dtype)
+
+
+def apply_rope(x: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding over the last axis. ``x``: (B, S, H, D)."""
+    seq_len, half = x.shape[1], x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]  # (1, S, 1, half)
+    sin = jnp.sin(angles)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Dense causal softmax attention; (B, S, H, D) in and out.
+
+    Scores accumulate in float32 on the MXU (``preferred_element_type``), the
+    softmax runs in float32, and the context matmul returns to the compute
+    dtype — the TPU mixed-precision idiom.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(d))
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
+    scores = jnp.where(mask[None, None, :, :], scores, jnp.float32(-1e30))
+    weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h, d = cfg.n_heads, cfg.head_dim
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            (h, d), axis=-1, use_bias=False, dtype=cfg.dtype, name=name
+        )
+        q = apply_rope(proj("q_proj")(x), cfg.rope_theta)
+        k = apply_rope(proj("k_proj")(x), cfg.rope_theta)
+        v = proj("v_proj")(x)
+        attn = cfg.attention_fn if cfg.attention_fn is not None else causal_attention
+        out = attn(q, k, v)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            name="o_proj",
+        )(out)
+
+
+class SwiGLU(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda f, name: nn.Dense(  # noqa: E731
+            f, use_bias=False, dtype=cfg.dtype, name=name
+        )
+        gate = nn.silu(dense(cfg.ff_dim, "gate_proj")(x))
+        up = dense(cfg.ff_dim, "up_proj")(x)
+        return dense(cfg.d_model, "down_proj")(gate * up)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.cfg, name="attn")(RMSNorm(name="attn_norm")(x))
+        x = x + SwiGLU(self.cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        return x
+
+
+class _ScanCell(nn.Module):
+    """``Block`` adapted to ``nn.scan``'s (carry, out) contract."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, _):
+        return Block(self.cfg, name="block")(x), None
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tokens (B, S) int32 -> logits (B, S, vocab)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        if tokens.shape[1] > cfg.max_seq_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds "
+                f"max_seq_len {cfg.max_seq_len}"
+            )
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="tok_emb"
+        )(tokens)
+        if cfg.scan_layers:
+            cell = _ScanCell
+            if cfg.remat:
+                cell = nn.remat(cell, prevent_cse=False)
+            stack = nn.scan(
+                cell,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+            )(cfg, name="layers")
+            x, _ = stack(x, None)
+        else:
+            block_cls = nn.remat(Block) if cfg.remat else Block
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, name=f"block_{i}")(x)
+        x = RMSNorm(name="final_norm")(x)
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
+        )(x)
+
+
+# Megatron-style tensor-parallel layout over the 'model' mesh axis:
+# column-split the head/ff output dims of q/k/v/gate/up, row-split the
+# input dims of o_proj/down_proj (one allreduce per residual branch),
+# vocab-split the LM head; embeddings replicated. Specs shorter than a
+# param's rank are left-padded with None (covers nn.scan's leading layer
+# axis). Consumed by parallel.tensor_parallel.TensorParallel.
+TP_RULES: list[tuple[str, P]] = [
+    (r".*/(q_proj|k_proj|v_proj)/kernel", P(None, "model", None)),
+    (r".*/o_proj/kernel", P("model", None, None)),
+    (r".*/(gate_proj|up_proj)/kernel", P(None, "model")),
+    (r".*/down_proj/kernel", P("model", None)),
+    (r".*/tok_emb/embedding", P(None, None)),
+    (r".*/lm_head/kernel", P(None, "model")),
+]

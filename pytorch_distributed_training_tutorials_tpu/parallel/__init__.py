@@ -26,6 +26,9 @@ from pytorch_distributed_training_tutorials_tpu.parallel.pipeline import (  # no
     ManualPipeline,
     partition_variables,
 )
+from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import (  # noqa: F401
+    TensorParallel,
+)
 
 # .auto (orbax checkpointing / auto placement) is imported lazily by users —
 # orbax is a heavyweight import and not needed on the hot path.

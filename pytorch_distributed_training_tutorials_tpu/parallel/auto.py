@@ -17,7 +17,6 @@ scratch).
 from __future__ import annotations
 
 import os
-import shutil
 from collections.abc import Callable
 
 import jax

@@ -38,6 +38,13 @@ class DataParallel:
     def num_devices(self) -> int:
         return self.mesh.shape.get(self.axis, 1)
 
+    def variable_shardings(self, abstract_variables):
+        """Uniform strategy interface: every variable replicated (the DDP
+        param-broadcast invariant), as a pytree matching the input."""
+        return jax.tree_util.tree_map(
+            lambda _: self.param_sharding, abstract_variables
+        )
+
     def shard_state(self, state):
         """Place a train state replicated on the mesh (the 'DDP broadcast')."""
         return jax.device_put(state, self.param_sharding)

@@ -63,6 +63,8 @@ def init(
         k in os.environ
         for k in (
             "JAX_COORDINATOR_ADDRESS",
+            "JAX_NUM_PROCESSES",
+            "JAX_PROCESS_ID",
             "COORDINATOR_ADDRESS",
             "TPU_WORKER_HOSTNAMES",
             "MEGASCALE_COORDINATOR_ADDRESS",

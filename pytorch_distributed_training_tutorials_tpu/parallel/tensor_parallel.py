@@ -1,0 +1,121 @@
+"""Tensor (intra-layer) parallelism: param-path sharding rules over a mesh.
+
+Beyond-parity capability (SURVEY.md section 2 marks TP absent in the
+reference — "nothing shards a single matmul"; the mesh reserves the ``model``
+axis for exactly this). Design: models stay placement-free plain pytrees; a
+strategy object maps param paths to :class:`~jax.sharding.PartitionSpec` via
+ordered regex rules (e.g. :data:`..models.transformer.TP_RULES`), and XLA's
+sharding propagation inserts the Megatron-pattern collectives (one allreduce
+per residual branch in the forward, the transpose in the backward).
+
+Composes with data parallelism on the same mesh: ``{'data': D, 'model': M}``
+gives DP x TP with the gradient allreduce riding the ``data`` axis and the
+activation collectives riding ``model`` — lay the ``model`` axis innermost so
+its (latency-bound) collectives stay on ICI.
+"""
+
+from __future__ import annotations
+
+import re
+from collections.abc import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+)
+
+
+def _path_str(key_path) -> str:
+    """'params/block_0/attn/q_proj/kernel'-style path string."""
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
+    )
+
+
+def _pad_spec(spec: PartitionSpec, ndim: int) -> PartitionSpec:
+    """Left-pad a spec with None up to ``ndim`` (covers nn.scan's leading
+    layer axis without per-model rule duplication)."""
+    parts = tuple(spec)
+    if len(parts) > ndim:
+        raise ValueError(f"spec {spec} longer than array rank {ndim}")
+    return PartitionSpec(*([None] * (ndim - len(parts)) + list(parts)))
+
+
+def spec_for_path(
+    path: str,
+    ndim: int,
+    rules: Sequence[tuple[str, PartitionSpec]],
+    default: PartitionSpec = PartitionSpec(),
+) -> PartitionSpec:
+    """First matching rule wins; unmatched params use ``default``
+    (replicated)."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return _pad_spec(spec, ndim)
+    return default
+
+
+class TensorParallel:
+    """DP x TP sharding strategy driven by param-path rules.
+
+    Drop-in for :class:`.data_parallel.DataParallel` in the Trainer: batches
+    shard over ``data``, params shard per ``rules`` over ``model`` (unmatched
+    params replicate — with no matching rules this *is* data parallelism).
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        rules: Sequence[tuple[str, PartitionSpec]],
+        axis: str = MODEL_AXIS,
+        data_axis: str = DATA_AXIS,
+    ):
+        self.mesh = mesh
+        self.rules = list(rules)
+        self.axis = axis
+        self.data_axis = data_axis
+        self.batch_sharding = NamedSharding(mesh, PartitionSpec(data_axis))
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.shape.get(self.data_axis, 1)
+
+    @property
+    def tp_size(self) -> int:
+        return self.mesh.shape.get(self.axis, 1)
+
+    def variable_shardings(self, abstract_variables):
+        """Pytree of NamedShardings for a (possibly abstract) variables
+        tree — the ``out_shardings`` for a sharded ``model.init``."""
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: NamedSharding(
+                self.mesh,
+                spec_for_path(_path_str(kp), getattr(leaf, "ndim", 0), self.rules),
+            ),
+            abstract_variables,
+        )
+
+    def shard_state(self, state):
+        """Place an existing train state per the rules (params + opt_state
+        follow the same path rules; scalars/step replicate)."""
+        shardings = self.variable_shardings(state)
+        return jax.tree_util.tree_map(jax.device_put, state, shardings)
+
+    def shard_batch(self, batch):
+        return jax.device_put(batch, self.batch_sharding)
+
+    def audit(self, params) -> list[str]:
+        """Path -> spec lines for the placement audit (the 03-notebook
+        device/dtype audit twin)."""
+        lines = []
+
+        def visit(kp, leaf):
+            path = _path_str(kp)
+            spec = spec_for_path(path, getattr(leaf, "ndim", 0), self.rules)
+            lines.append(f"{path}: {tuple(leaf.shape)} -> {tuple(spec)}")
+
+        jax.tree_util.tree_map_with_path(visit, params)
+        return lines

@@ -67,7 +67,7 @@ def create_train_state(
     optimizer: optax.GradientTransformation,
     sample_input,
     *,
-    strategy: DataParallel,
+    strategy,
     seed: int = 0,
 ) -> TrainState:
     """Init model variables replicated on the mesh and wrap in a TrainState.
@@ -78,9 +78,11 @@ def create_train_state(
     """
     key = jax.random.PRNGKey(seed)
     sample = jnp.asarray(sample_input[:1])
-    variables = jax.jit(model.init, out_shardings=strategy.param_sharding)(
-        key, sample
-    )
+    # Per-parameter placement: replicated for data parallelism, rule-driven
+    # for tensor/hybrid parallelism — one strategy interface either way.
+    abstract = jax.eval_shape(model.init, key, sample)
+    out_shardings = strategy.variable_shardings(abstract)
+    variables = jax.jit(model.init, out_shardings=out_shardings)(key, sample)
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
     state = TrainState.create(
@@ -179,7 +181,7 @@ class Trainer:
         train_loader,
         optimizer: optax.GradientTransformation,
         *,
-        strategy: DataParallel | None = None,
+        strategy=None,  # DataParallel | TensorParallel | compatible
         loss: str = "cross_entropy",
         seed: int = 0,
         log_every: int | None = None,

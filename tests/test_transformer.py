@@ -1,0 +1,100 @@
+"""Transformer LM: shapes, causality, scan/loop equivalence, learnability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.data import (
+    ShardedLoader,
+    synthetic_lm,
+)
+from pytorch_distributed_training_tutorials_tpu.models import (
+    TransformerConfig,
+    TransformerLM,
+)
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+from pytorch_distributed_training_tutorials_tpu.train import Trainer
+
+CFG = TransformerConfig(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+                        max_seq_len=32)
+
+
+def _init_and_apply(cfg, tokens, seed=0):
+    model = TransformerLM(cfg)
+    variables = model.init(jax.random.PRNGKey(seed), tokens)
+    return model, variables, model.apply(variables, tokens)
+
+
+def test_forward_shape_and_dtype():
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    _, _, logits = _init_and_apply(CFG, tokens)
+    assert logits.shape == (2, 16, 64)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality():
+    """Logits at position t must not depend on tokens after t."""
+    rng = np.random.Generator(np.random.PCG64(0))
+    tokens = rng.integers(0, 64, (1, 16)).astype(np.int32)
+    model, variables, logits = _init_and_apply(CFG, jnp.asarray(tokens))
+    perturbed = tokens.copy()
+    perturbed[0, 10:] = (perturbed[0, 10:] + 7) % 64
+    logits_p = model.apply(variables, jnp.asarray(perturbed))
+    np.testing.assert_allclose(
+        np.asarray(logits[0, :10]), np.asarray(logits_p[0, :10]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits[0, 10:]), np.asarray(logits_p[0, 10:]))
+
+
+def test_scan_matches_loop():
+    """scan_layers=True is a compile-time optimization, not a model change —
+    same params (transposed into the stacked layout) give the same logits."""
+    tokens = jnp.asarray(
+        np.random.Generator(np.random.PCG64(1)).integers(0, 64, (2, 8)),
+        jnp.int32,
+    )
+    loop_cfg = CFG
+    scan_cfg = TransformerConfig(**{**CFG.__dict__, "scan_layers": True})
+    _, loop_vars, loop_logits = _init_and_apply(loop_cfg, tokens)
+
+    # restack loop params [block_0, block_1] -> scanned layout
+    blocks = [loop_vars["params"][f"block_{i}"] for i in range(CFG.n_layers)]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *blocks
+    )
+    scan_params = {
+        "tok_emb": loop_vars["params"]["tok_emb"],
+        "final_norm": loop_vars["params"]["final_norm"],
+        "lm_head": loop_vars["params"]["lm_head"],
+        "layers": {"block": stacked},
+    }
+    scan_logits = TransformerLM(scan_cfg).apply({"params": scan_params}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(loop_logits), np.asarray(scan_logits), atol=1e-5
+    )
+
+
+def test_remat_matches_plain():
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    remat_cfg = TransformerConfig(**{**CFG.__dict__, "remat": True})
+    _, variables, plain = _init_and_apply(CFG, tokens)
+    remat_logits = TransformerLM(remat_cfg).apply(variables, tokens)
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(remat_logits), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_lm_loss_decreases_data_parallel():
+    """End-to-end: the bigram dataset is learnable; CE drops well below
+    log(vocab) (uniform-prediction level) within a few epochs."""
+    mesh = create_mesh({"data": 8})
+    ds = synthetic_lm(size=512, seq_len=32, vocab_size=64)
+    loader = ShardedLoader(ds, 8, mesh)
+    trainer = Trainer(
+        TransformerLM(CFG), loader, optax.adam(3e-3), loss="cross_entropy"
+    )
+    first = trainer._run_epoch(0)
+    last = trainer.train(4)
+    assert first["loss"] < np.log(64) + 0.5
+    assert last["loss"] < first["loss"] * 0.75
