@@ -49,6 +49,7 @@ class ShardedLoader:
         shuffle: bool = True,
         seed: int = 0,
         drop_last: bool = False,
+        batch_spec: PartitionSpec | None = None,
     ):
         if batch_mode not in ("per_device", "global"):
             raise ValueError(f"unknown batch_mode {batch_mode!r}")
@@ -66,7 +67,24 @@ class ShardedLoader:
         else:
             self.per_device_batch = batch_size
         self.global_batch = self.per_device_batch * self.world
-        self.sharding = NamedSharding(mesh, PartitionSpec(axis))
+        # batch_spec overrides the default dim-0-over-data layout, e.g.
+        # P('data', 'seq') shards tokens over the sequence axis too (sequence
+        # parallelism). Dim 0 must still map to `axis` — the steps/shard math
+        # is defined by the data-parallel world size.
+        spec = batch_spec if batch_spec is not None else PartitionSpec(axis)
+        dim0 = tuple(spec)[0] if len(tuple(spec)) else None
+        if self.world > 1 and dim0 != axis:
+            raise ValueError(
+                f"batch_spec dim 0 must map to the loader axis {axis!r} "
+                f"(got {dim0!r}): steps/shard math assumes it"
+            )
+        # Per-array shardings: the spec truncates to each array's rank so a
+        # (B, S) token array and a (B,) label array can share one batch_spec.
+        self._shardings = [
+            NamedSharding(mesh, PartitionSpec(*tuple(spec)[: a.ndim]))
+            for a in dataset.arrays
+        ]
+        self.sharding = self._shardings[0]
         # One logical sampler per data-parallel replica; we enumerate all
         # replicas' shards from rank 0's view because under SPMD a single
         # controller feeds every local device.
@@ -114,7 +132,9 @@ class ShardedLoader:
                         arr[rows][(slice(None), *index[1:])]
                     )
 
-                return jax.make_array_from_callback(gshape, self.sharding, cb)
+                return jax.make_array_from_callback(
+                    gshape, self._shardings[ai], cb
+                )
 
             batch = tuple(make(ai) for ai in range(n_arrays))
             yield batch if n_arrays > 1 else batch[0]

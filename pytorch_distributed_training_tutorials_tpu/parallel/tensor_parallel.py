@@ -44,17 +44,33 @@ def _pad_spec(spec: PartitionSpec, ndim: int) -> PartitionSpec:
     return PartitionSpec(*([None] * (ndim - len(parts)) + list(parts)))
 
 
+def _filter_spec(spec: PartitionSpec, mesh: Mesh | None) -> PartitionSpec:
+    """Drop axis names the mesh doesn't have (-> replicated on that dim), so
+    one rule set serves every mesh shape (pure-DP, DPxSP, DPxTP, ...)."""
+    if mesh is None:
+        return spec
+    keep = lambda a: a if a in mesh.shape else None  # noqa: E731
+    return PartitionSpec(
+        *(
+            tuple(x for x in a if x in mesh.shape) if isinstance(a, tuple)
+            else keep(a)
+            for a in spec
+        )
+    )
+
+
 def spec_for_path(
     path: str,
     ndim: int,
     rules: Sequence[tuple[str, PartitionSpec]],
     default: PartitionSpec = PartitionSpec(),
+    mesh: Mesh | None = None,
 ) -> PartitionSpec:
     """First matching rule wins; unmatched params use ``default``
-    (replicated)."""
+    (replicated). With ``mesh``, axis names the mesh lacks are dropped."""
     for pattern, spec in rules:
         if re.search(pattern, path):
-            return _pad_spec(spec, ndim)
+            return _filter_spec(_pad_spec(spec, ndim), mesh)
     return default
 
 
@@ -72,12 +88,22 @@ class TensorParallel:
         rules: Sequence[tuple[str, PartitionSpec]],
         axis: str = MODEL_AXIS,
         data_axis: str = DATA_AXIS,
+        seq_axis: str | None = None,
     ):
         self.mesh = mesh
         self.rules = list(rules)
         self.axis = axis
         self.data_axis = data_axis
-        self.batch_sharding = NamedSharding(mesh, PartitionSpec(data_axis))
+        self.seq_axis = seq_axis
+        # with a seq axis, batches (B, S, ...) shard over data x seq —
+        # sequence parallelism's input layout. Axes the mesh lacks drop out
+        # (pure-SP meshes have no 'data'; pure-DP meshes no 'seq').
+        batch_spec = _filter_spec(
+            PartitionSpec(data_axis, seq_axis) if seq_axis is not None
+            else PartitionSpec(data_axis),
+            mesh,
+        )
+        self.batch_sharding = NamedSharding(mesh, batch_spec)
 
     @property
     def num_devices(self) -> int:
@@ -93,7 +119,10 @@ class TensorParallel:
         return jax.tree_util.tree_map_with_path(
             lambda kp, leaf: NamedSharding(
                 self.mesh,
-                spec_for_path(_path_str(kp), getattr(leaf, "ndim", 0), self.rules),
+                spec_for_path(
+                    _path_str(kp), getattr(leaf, "ndim", 0), self.rules,
+                    mesh=self.mesh,
+                ),
             ),
             abstract_variables,
         )
@@ -114,7 +143,9 @@ class TensorParallel:
 
         def visit(kp, leaf):
             path = _path_str(kp)
-            spec = spec_for_path(path, getattr(leaf, "ndim", 0), self.rules)
+            spec = spec_for_path(
+                path, getattr(leaf, "ndim", 0), self.rules, mesh=self.mesh
+            )
             lines.append(f"{path}: {tuple(leaf.shape)} -> {tuple(spec)}")
 
         jax.tree_util.tree_map_with_path(visit, params)

@@ -77,7 +77,12 @@ def create_train_state(
     from identical params (same PRNG key -> same init, placed replicated).
     """
     key = jax.random.PRNGKey(seed)
-    sample = jnp.asarray(sample_input[:1])
+    # One row per data-parallel replica: models whose forward shards the batch
+    # explicitly (shard_map, e.g. ring attention) need init shapes divisible
+    # by the mesh axes; params themselves are batch-size independent.
+    sample = jnp.asarray(
+        sample_input[: max(1, getattr(strategy, "num_devices", 1))]
+    )
     # Per-parameter placement: replicated for data parallelism, rule-driven
     # for tensor/hybrid parallelism — one strategy interface either way.
     abstract = jax.eval_shape(model.init, key, sample)
@@ -191,7 +196,7 @@ class Trainer:
         self.strategy = strategy if strategy is not None else DataParallel(
             train_loader.mesh
         )
-        sample = train_loader.dataset.arrays[0][:1]
+        sample = train_loader.dataset.arrays[0]  # create_train_state slices
         self.state = create_train_state(
             model, optimizer, sample, strategy=self.strategy, seed=seed
         )

@@ -1,0 +1,111 @@
+"""Ring attention: numeric equivalence to dense causal attention + SP e2e."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_tutorials_tpu.data import (
+    ShardedLoader,
+    synthetic_lm,
+)
+from pytorch_distributed_training_tutorials_tpu.models import (
+    TP_RULES,
+    TransformerConfig,
+    TransformerLM,
+)
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    causal_attention,
+)
+from pytorch_distributed_training_tutorials_tpu.parallel import TensorParallel
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+from pytorch_distributed_training_tutorials_tpu.parallel.ring_attention import (
+    make_ring_attention,
+)
+from pytorch_distributed_training_tutorials_tpu.train import Trainer
+
+
+def _qkv(b=2, s=32, h=4, d=16, seed=0):
+    rng = np.random.Generator(np.random.PCG64(seed))
+    return tuple(
+        jnp.asarray(rng.standard_normal((b, s, h, d)).astype(np.float32))
+        for _ in range(3)
+    )
+
+
+def test_ring_matches_dense_seq_only():
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv()
+    ring = make_ring_attention(mesh)
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(ring)(q, k, v)),
+        np.asarray(causal_attention(q, k, v)),
+        atol=2e-5,
+    )
+
+
+def test_ring_matches_dense_dp_x_sp():
+    mesh = create_mesh({"data": 2, "seq": 4})
+    q, k, v = _qkv(b=4, s=16)
+    ring = make_ring_attention(mesh)
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(ring)(q, k, v)),
+        np.asarray(causal_attention(q, k, v)),
+        atol=2e-5,
+    )
+
+
+def test_ring_requires_seq_axis():
+    mesh = create_mesh({"data": 8})
+    import pytest
+
+    with pytest.raises(ValueError, match="no 'seq' axis"):
+        make_ring_attention(mesh)
+
+
+def test_transformer_logits_identical_with_ring():
+    """Same params, dense vs ring attention: logits match — SP is a layout
+    choice, not a model change."""
+    mesh = create_mesh({"data": 2, "seq": 4})
+    base = TransformerConfig(vocab_size=64, d_model=64, n_layers=2, n_heads=4)
+    ring_cfg = TransformerConfig(
+        **{**base.__dict__, "attention_fn": make_ring_attention(mesh)}
+    )
+    tokens = jnp.asarray(
+        np.random.Generator(np.random.PCG64(2)).integers(0, 64, (4, 16)),
+        jnp.int32,
+    )
+    dense_model = TransformerLM(base)
+    variables = dense_model.init(jax.random.PRNGKey(0), tokens)
+    dense_logits = dense_model.apply(variables, tokens)
+    ring_logits = jax.jit(TransformerLM(ring_cfg).apply)(variables, tokens)
+    np.testing.assert_allclose(
+        np.asarray(dense_logits), np.asarray(ring_logits), atol=3e-5
+    )
+
+
+def test_sp_training_end_to_end():
+    """Full SP training: tokens sharded (data, seq), ring attention inside
+    the jitted train step, loss decreases."""
+    mesh = create_mesh({"data": 2, "seq": 4})
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+        attention_fn=make_ring_attention(mesh),
+    )
+    strategy = TensorParallel(mesh, TP_RULES, seq_axis="seq")
+    ds = synthetic_lm(size=128, seq_len=32, vocab_size=64)
+    loader = ShardedLoader(
+        ds, 16, mesh, batch_spec=P("data", "seq")
+    )
+    trainer = Trainer(
+        TransformerLM(cfg), loader, optax.adam(3e-3), strategy=strategy,
+        loss="cross_entropy",
+    )
+    # token batches really are seq-sharded
+    batch = next(iter(loader))
+    assert batch[0].shape == (32, 32)
+    assert {s.data.shape for s in batch[0].addressable_shards} == {(16, 8)}
+    first = trainer._run_epoch(0)
+    last = trainer.train(3)
+    assert last["loss"] < first["loss"]
