@@ -27,6 +27,12 @@ from pytorch_distributed_training_tutorials_tpu.models.transformer import (  # n
     TransformerConfig,
     TransformerLM,
     TP_RULES,
+    ep_rules,
+)
+from pytorch_distributed_training_tutorials_tpu.models.moe import (  # noqa: F401
+    MoEFFN,
+    MOE_RULES,
+    moe_aux_loss,
 )
 from pytorch_distributed_training_tutorials_tpu.models.utils import (  # noqa: F401
     model_size,

@@ -1,0 +1,127 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``expert`` axis.
+
+Beyond-parity capability (the reference has no MoE anywhere — SURVEY.md
+section 2 marks EP absent). TPU-first design: the GShard/Mixtral dense-
+dispatch formulation — routing, capacity accounting, dispatch and combine are
+all static-shape einsums, so the whole layer jits into MXU matmuls with no
+gather/scatter or data-dependent shapes. Expert parallelism is pure sharding:
+expert-stacked weights (E, ...) shard over the ``expert`` mesh axis
+(:data:`MOE_RULES`), and XLA derives the token all-to-all from the dispatch
+einsum's operand shardings — the reference-world equivalent (DeepSpeed-MoE's
+hand-written all_to_all) is compiled in, not called.
+
+Top-k routing with per-(batch-row, expert) capacity ``C =
+ceil(S * k / E) * capacity_factor``: tokens pick experts greedily (k-th
+choices queue behind all (k-1)-th choices); tokens over capacity are dropped
+(standard GShard semantics — the residual connection carries them). The
+load-balancing auxiliary loss is sown into the ``"losses"`` collection;
+:func:`moe_aux_loss` sums it for adding to the objective.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import EXPERT_AXIS
+
+
+class MoEFFN(nn.Module):
+    """Top-k routed SwiGLU experts, dense-dispatch (drop-in for a dense FFN).
+
+    Input/output: (B, S, d_model). Expert weights are stacked on a leading
+    expert dim so one einsum runs every expert — the layout that shards over
+    the ``expert`` mesh axis.
+    """
+
+    num_experts: int = 8
+    top_k: int = 2
+    d_ff: int | None = None
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        e, k = self.num_experts, self.top_k
+        ff = self.d_ff if self.d_ff is not None else 4 * d
+        cap = int(-(-s * k // e) * self.capacity_factor)
+        cap = max(cap, 1)
+
+        # --- routing (float32: small tensors, numerically load-bearing) ---
+        router = self.param(
+            "router", nn.initializers.lecun_normal(), (d, e), jnp.float32
+        )
+        gates = jax.nn.softmax(
+            jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router), axis=-1
+        )
+
+        # greedy top-k: k passes of argmax, each masking its pick
+        g = gates
+        picks, weights = [], []
+        for _ in range(k):
+            idx = jnp.argmax(g, axis=-1)
+            onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (B,S,E)
+            picks.append(onehot)
+            weights.append(jnp.sum(g * onehot, axis=-1))  # (B,S)
+            g = g * (1.0 - onehot)
+        weight_sum = sum(weights) + 1e-9
+
+        # --- load-balancing aux loss (Switch/GShard form) ---
+        frac_tokens = jnp.mean(picks[0], axis=1)  # (B,E) first-choice load
+        frac_probs = jnp.mean(gates, axis=1)  # (B,E)
+        self.sow(
+            "losses",
+            "moe_aux_loss",
+            e * jnp.mean(jnp.sum(frac_tokens * frac_probs, axis=-1)),
+        )
+
+        # --- capacity accounting: first choices fill before second, ... ---
+        dispatch = jnp.zeros((b, s, e, cap), jnp.float32)
+        combine = jnp.zeros((b, s, e, cap), jnp.float32)
+        filled = jnp.zeros((b, e), jnp.float32)
+        for onehot, w in zip(picks, weights):
+            pos = filled[:, None, :] + jnp.cumsum(onehot, axis=1) - onehot
+            filled = filled + jnp.sum(onehot, axis=1)
+            keep = onehot * (pos < cap)  # (B,S,E)
+            slot = jax.nn.one_hot(pos.astype(jnp.int32), cap) * keep[..., None]
+            dispatch = dispatch + slot
+            combine = combine + slot * (w / weight_sum)[:, :, None, None]
+
+        # --- expert compute: one einsum per projection over all experts ---
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("w_gate", init, (e, d, ff), jnp.float32)
+        w_up = self.param("w_up", init, (e, d, ff), jnp.float32)
+        w_down = self.param("w_down", init, (e, ff, d), jnp.float32)
+
+        xin = jnp.einsum(
+            "bsec,bsd->becd", dispatch.astype(self.dtype), x.astype(self.dtype)
+        )
+        h = nn.silu(
+            jnp.einsum("becd,edf->becf", xin, w_gate.astype(self.dtype))
+        ) * jnp.einsum("becd,edf->becf", xin, w_up.astype(self.dtype))
+        out = jnp.einsum("becf,efd->becd", h, w_down.astype(self.dtype))
+        return jnp.einsum(
+            "bsec,becd->bsd", combine.astype(self.dtype), out
+        ).astype(x.dtype)
+
+
+def moe_aux_loss(variables_or_updates) -> jax.Array:
+    """Sum every sown ``moe_aux_loss`` (one per MoE layer; each sown value is
+    a 1-tuple). Add ``aux_weight * moe_aux_loss(updates)`` to the objective."""
+    losses = variables_or_updates.get("losses", {})
+    total = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(losses):
+        total = total + jnp.sum(leaf)
+    return total
+
+
+# Expert-parallel layout: stacked expert weights shard on the expert dim;
+# the router is replicated. Merge with the transformer's TP_RULES for a
+# combined dp x tp x ep layout.
+MOE_RULES: list[tuple[str, P]] = [
+    (r".*/(w_gate|w_up|w_down)", P(EXPERT_AXIS, None, None)),
+    (r".*/router", P(None, None)),
+]

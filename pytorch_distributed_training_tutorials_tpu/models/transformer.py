@@ -30,6 +30,11 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
+from pytorch_distributed_training_tutorials_tpu.models.moe import (
+    MOE_RULES,
+    MoEFFN,
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -46,6 +51,11 @@ class TransformerConfig:
     # attention_fn(q, k, v) -> out, all (B, S, H, D), causal semantics.
     # None = dense causal softmax attention on-device.
     attention_fn: Callable | None = None
+    # Mixture-of-Experts: >0 replaces every block's dense FFN with a routed
+    # MoEFFN of that many experts (see models/moe.py; shard with ep_rules()).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def ff_dim(self) -> int:
@@ -141,9 +151,20 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        x = x + Attention(self.cfg, name="attn")(RMSNorm(name="attn_norm")(x))
-        x = x + SwiGLU(self.cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
-        return x
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x))
+        if cfg.moe_experts > 0:
+            ffn = MoEFFN(
+                num_experts=cfg.moe_experts,
+                top_k=cfg.moe_top_k,
+                d_ff=cfg.ff_dim,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype,
+                name="moe",
+            )
+        else:
+            ffn = SwiGLU(cfg, name="mlp")
+        return x + ffn(RMSNorm(name="mlp_norm")(x))
 
 
 class _ScanCell(nn.Module):
@@ -178,7 +199,9 @@ class TransformerLM(nn.Module):
                 cell = nn.remat(cell, prevent_cse=False)
             stack = nn.scan(
                 cell,
-                variable_axes={"params": 0},
+                # 'losses' rides along axis 0 so per-layer sown values (MoE
+                # load balancing) survive the scan instead of being dropped
+                variable_axes={"params": 0, "losses": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
             )(cfg, name="layers")
@@ -207,3 +230,8 @@ TP_RULES: list[tuple[str, P]] = [
     (r".*/tok_emb/embedding", P(None, None)),
     (r".*/lm_head/kernel", P(None, "model")),
 ]
+
+
+def ep_rules() -> list[tuple[str, P]]:
+    """TP + expert-parallel rules for an MoE transformer (dp x tp x ep)."""
+    return MOE_RULES + TP_RULES

@@ -29,6 +29,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 STAGE_AXIS = "stage"
 SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
 
 
 def create_mesh(

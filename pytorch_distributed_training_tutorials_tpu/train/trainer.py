@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import optax
 from flax import core, struct
 
+from pytorch_distributed_training_tutorials_tpu.models.moe import moe_aux_loss
 from pytorch_distributed_training_tutorials_tpu.parallel.data_parallel import (
     DataParallel,
 )
@@ -108,7 +109,11 @@ def _compute_loss(loss: str, logits, targets):
     raise ValueError(f"unknown loss {loss!r}")
 
 
-def make_train_step(loss: str = "cross_entropy", has_batch_stats: bool = False):
+def make_train_step(
+    loss: str = "cross_entropy",
+    has_batch_stats: bool = False,
+    aux_loss_weight: float = 0.0,
+):
     """Build the jitted SPMD train step (donated state).
 
     One compiled program per step replaces the reference's
@@ -116,22 +121,34 @@ def make_train_step(loss: str = "cross_entropy", has_batch_stats: bool = False):
     (``ddp_gpus.py:34-39``). Gradients come out replicated — XLA inserts the
     ICI allreduce during the backward because params are replicated while the
     batch is sharded.
+
+    ``aux_loss_weight`` > 0 collects the model's sown ``"losses"`` collection
+    (MoE load-balancing) and adds it, weighted, to the objective.
     """
 
     def step_fn(state: TrainState, batch):
         x, y = batch
 
         def loss_fn(params):
+            variables = {"params": params}
+            mutable = []
+            kwargs = {}
             if has_batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                mutable.append("batch_stats")
+                kwargs["train"] = True
+            if aux_loss_weight:
+                mutable.append("losses")
+            if mutable:
                 out, updates = state.apply_fn(
-                    {"params": params, "batch_stats": state.batch_stats},
-                    x,
-                    train=True,
-                    mutable=["batch_stats"],
+                    variables, x, mutable=mutable, **kwargs
                 )
-                return _compute_loss(loss, out, y), updates["batch_stats"]
-            out = state.apply_fn({"params": params}, x)
-            return _compute_loss(loss, out, y), None
+            else:
+                out, updates = state.apply_fn(variables, x), {}
+            loss_val = _compute_loss(loss, out, y)
+            if aux_loss_weight:
+                loss_val = loss_val + aux_loss_weight * moe_aux_loss(updates)
+            return loss_val, updates.get("batch_stats")
 
         (loss_val, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
@@ -188,6 +205,7 @@ class Trainer:
         *,
         strategy=None,  # DataParallel | TensorParallel | compatible
         loss: str = "cross_entropy",
+        aux_loss_weight: float = 0.0,
         seed: int = 0,
         log_every: int | None = None,
     ):
@@ -202,7 +220,9 @@ class Trainer:
         )
         self.has_batch_stats = self.state.batch_stats is not None
         self.train_step = make_train_step(
-            loss=loss, has_batch_stats=self.has_batch_stats
+            loss=loss,
+            has_batch_stats=self.has_batch_stats,
+            aux_loss_weight=aux_loss_weight,
         )
         self.log_every = log_every
         self.last_epoch_metrics: dict = {}
