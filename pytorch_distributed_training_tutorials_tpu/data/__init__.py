@@ -20,3 +20,6 @@ from pytorch_distributed_training_tutorials_tpu.data.datasets import (  # noqa: 
 from pytorch_distributed_training_tutorials_tpu.data.loader import (  # noqa: F401
     ShardedLoader,
 )
+from pytorch_distributed_training_tutorials_tpu.data.prefetch import (  # noqa: F401
+    PrefetchLoader,
+)

@@ -25,6 +25,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from pytorch_distributed_training_tutorials_tpu.data.native import gather_rows
+
 DATA_DIR = os.environ.get("DATA_DIR", os.path.expanduser("~/.cache/tpu_ddp_data"))
 
 
@@ -54,7 +56,7 @@ class ArrayDataset:
         return tuple(a[i] for a in self.arrays)
 
     def gather(self, indices: np.ndarray) -> tuple[np.ndarray, ...]:
-        return tuple(a[indices] for a in self.arrays)
+        return tuple(gather_rows(a, indices) for a in self.arrays)
 
 
 def synthetic_regression(

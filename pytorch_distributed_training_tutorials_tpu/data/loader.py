@@ -31,6 +31,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from pytorch_distributed_training_tutorials_tpu.data.datasets import ArrayDataset
+from pytorch_distributed_training_tutorials_tpu.data.native import gather_rows
 from pytorch_distributed_training_tutorials_tpu.data.sampler import DistributedSampler
 from pytorch_distributed_training_tutorials_tpu.parallel.mesh import DATA_AXIS
 
@@ -125,11 +126,18 @@ class ShardedLoader:
             def make(ai: int):
                 arr = self.dataset.arrays[ai]
                 gshape = (self.global_batch, *gshape_tail[ai])
+                # memoize per row-slice: with non-batch axes sharded too
+                # (e.g. P('data','seq')), the callback fires once per
+                # (row, col) block — gather each row block only once
+                gathered: dict = {}
 
                 def cb(index):
-                    rows = flat_idx[index[0]]
+                    key = (index[0].start, index[0].stop)
+                    if key not in gathered:
+                        # native multithreaded row gather (numpy fallback)
+                        gathered[key] = gather_rows(arr, flat_idx[index[0]])
                     return np.ascontiguousarray(
-                        arr[rows][(slice(None), *index[1:])]
+                        gathered[key][(slice(None), *index[1:])]
                     )
 
                 return jax.make_array_from_callback(

@@ -1,0 +1,80 @@
+"""Prefetching loader: overlap host batch assembly/H2D with device compute.
+
+The reference's DataLoader gets this from worker processes + ``pin_memory``
+(``ddp_gpus.py:73-79``); the TPU twin is a single background thread that runs
+the inner loader's gather + ``make_array_from_callback`` (which enqueues the
+H2D copies) one-to-two steps ahead of the training loop, so by the time
+``train_step`` needs batch N+1 its transfers are already in flight. XLA's
+async dispatch does the rest — the device never waits on the host for
+tutorial-scale data.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+_SENTINEL = object()
+
+
+class PrefetchLoader:
+    """Wrap any epoch-iterable loader; yields identical batches, ahead of
+    time. Delegates the loader surface (``set_epoch``, lengths, mesh)."""
+
+    def __init__(self, loader, prefetch: int = 2):
+        if prefetch < 1:
+            raise ValueError("prefetch must be >= 1")
+        self.loader = loader
+        self.prefetch = prefetch
+
+    # --- delegated surface -------------------------------------------------
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __getattr__(self, name):
+        return getattr(self.loader, name)
+
+    # --- iteration ---------------------------------------------------------
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        err: list[BaseException] = []
+        stop = threading.Event()
+
+        def put_or_stop(item) -> bool:
+            """Blocking put that aborts when the consumer bailed; returns
+            False on abort. The sentinel MUST go through here too — a
+            dropped sentinel leaves the consumer blocked forever."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for batch in self.loader:
+                    if not put_or_stop(batch):
+                        return
+            except BaseException as e:  # surfaced in the consumer
+                err.append(e)
+            finally:
+                put_or_stop(_SENTINEL)
+
+        t = threading.Thread(target=producer, daemon=True, name="prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    break
+                yield item
+            if err:
+                raise err[0]
+        finally:
+            stop.set()
+            t.join(timeout=10)

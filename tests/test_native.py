@@ -1,0 +1,136 @@
+"""Native gather + prefetch loader: exactness, fallback, integration."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.data import (
+    ArrayDataset,
+    PrefetchLoader,
+    ShardedLoader,
+)
+from pytorch_distributed_training_tutorials_tpu.data.native import (
+    gather_rows,
+    native_available,
+)
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+
+
+def test_native_builds_and_loads():
+    # g++ is baked into this environment; the native path must come up
+    assert native_available()
+
+
+@pytest.mark.parametrize(
+    "shape,dtype",
+    [
+        ((64,), np.float32),
+        ((64, 20), np.float32),
+        ((64, 8, 8, 3), np.uint8),
+        ((64, 7), np.int64),
+        ((300, 33), np.float64),
+    ],
+)
+def test_gather_matches_numpy(shape, dtype):
+    rng = np.random.Generator(np.random.PCG64(0))
+    arr = (rng.random(shape) * 100).astype(dtype)
+    rows = rng.integers(-len(arr), len(arr), 128)  # negatives included
+    np.testing.assert_array_equal(gather_rows(arr, rows), arr[rows])
+
+
+def test_gather_large_multithreaded_path():
+    rng = np.random.Generator(np.random.PCG64(1))
+    arr = rng.random((2048, 1024)).astype(np.float32)  # 8MB -> threaded
+    rows = rng.integers(0, 2048, 4096)
+    np.testing.assert_array_equal(gather_rows(arr, rows), arr[rows])
+
+
+def test_gather_out_of_range_raises():
+    arr = np.zeros((8, 2), np.float32)
+    with pytest.raises(IndexError):
+        gather_rows(arr, np.asarray([3, 8]))
+
+
+def test_gather_nonstandard_indices_fall_back_exactly():
+    """Boolean masks, 2-d and 0-d index arrays keep numpy semantics."""
+    rng = np.random.Generator(np.random.PCG64(3))
+    arr = rng.random((6, 4)).astype(np.float32)
+    mask = np.asarray([True, False, True, False, False, True])
+    np.testing.assert_array_equal(gather_rows(arr, mask), arr[mask])
+    idx2d = np.asarray([[0, 1], [2, 3]])
+    np.testing.assert_array_equal(gather_rows(arr, idx2d), arr[idx2d])
+    idx0d = np.asarray(4)
+    np.testing.assert_array_equal(gather_rows(arr, idx0d), arr[idx0d])
+
+
+def test_gather_noncontiguous_falls_back():
+    arr = np.asfortranarray(np.arange(24, dtype=np.float32).reshape(6, 4))
+    rows = np.asarray([5, 0, 3])
+    np.testing.assert_array_equal(gather_rows(arr, rows), arr[rows])
+
+
+def _epoch_batches(loader, epoch):
+    loader.set_epoch(epoch)
+    return [tuple(np.asarray(a) for a in b) for b in loader]
+
+
+def test_prefetch_loader_identical_batches():
+    mesh = create_mesh({"data": 8})
+    rng = np.random.Generator(np.random.PCG64(2))
+    ds = ArrayDataset(
+        (
+            rng.random((128, 6)).astype(np.float32),
+            rng.integers(0, 4, 128).astype(np.int32),
+        )
+    )
+    plain = ShardedLoader(ds, 4, mesh, seed=0)
+    wrapped = PrefetchLoader(ShardedLoader(ds, 4, mesh, seed=0), prefetch=2)
+    assert len(wrapped) == len(plain)
+    assert wrapped.global_batch == plain.global_batch  # delegation
+    for epoch in (0, 1):
+        for (a1, b1), (a2, b2) in zip(
+            _epoch_batches(plain, epoch), _epoch_batches(wrapped, epoch)
+        ):
+            np.testing.assert_array_equal(a1, a2)
+            np.testing.assert_array_equal(b1, b2)
+
+
+def test_prefetch_loader_early_break_and_reuse():
+    mesh = create_mesh({"data": 8})
+    ds = ArrayDataset((np.zeros((64, 4), np.float32),))
+    loader = PrefetchLoader(ShardedLoader(ds, 4, mesh), prefetch=1)
+    for i, _ in enumerate(loader):
+        if i == 0:
+            break  # bail mid-epoch; producer must shut down
+    assert len(list(loader)) == len(loader)  # reusable afterwards
+
+
+def test_prefetch_propagates_producer_error():
+    class Boom:
+        def __iter__(self):
+            yield 1
+            raise RuntimeError("producer failed")
+
+        def __len__(self):
+            return 2
+
+    with pytest.raises(RuntimeError, match="producer failed"):
+        list(PrefetchLoader(Boom()))
+
+
+def test_trainer_works_with_prefetch():
+    import optax
+
+    from pytorch_distributed_training_tutorials_tpu.data import (
+        synthetic_regression,
+    )
+    from pytorch_distributed_training_tutorials_tpu.models import LinearRegressor
+    from pytorch_distributed_training_tutorials_tpu.train import Trainer
+
+    mesh = create_mesh({"data": 8})
+    loader = PrefetchLoader(
+        ShardedLoader(synthetic_regression(256), 8, mesh)
+    )
+    trainer = Trainer(LinearRegressor(), loader, optax.sgd(1e-2), loss="mse")
+    first = trainer._run_epoch(0)
+    last = trainer.train(3)
+    assert last["loss"] < first["loss"]
