@@ -11,3 +11,9 @@ from pytorch_distributed_training_tutorials_tpu.ops.debug import (  # noqa: F401
     per_shard_shapes,
     describe_sharding,
 )
+from pytorch_distributed_training_tutorials_tpu.ops.quant import (  # noqa: F401
+    Int8Dense,
+    Int8Param,
+    int8_matmul,
+    quantize_int8,
+)

@@ -1,0 +1,186 @@
+"""Int8 quantization + Pallas int8 matmul: the bitsandbytes twin.
+
+Reference capability (SURVEY.md C13): ``from_pretrained(...,
+BitsAndBytesConfig(load_in_8bit=True))`` loads Llama-7B with int8 matmul
+weights and float16 norms (``03.model_parallel.ipynb`` cell 2; param audit
+cell 4). The TPU-native equivalent implemented here:
+
+- :func:`quantize_int8` — per-channel symmetric weight quantization
+  (absmax / 127, the bitsandbytes vector-wise scheme) into an
+  :class:`Int8Param` pytree leaf.
+- :func:`int8_matmul` — a Pallas TPU kernel computing
+  ``x @ dequant(q, scale)`` the LLM.int8 way: activations are quantized
+  per-row *inside* the kernel, the MXU runs a true int8 x int8 -> int32
+  matmul, and the int32 accumulator is dequantized by the outer product of
+  row and column scales. HBM traffic for the weight is 1/4 of f32 — the
+  point of 8-bit serving. Runs in interpreter mode off-TPU so tests are
+  hardware-free (and cross-checked against the pure-jnp reference math).
+- :class:`Int8Dense` — drop-in serving twin of ``nn.Dense`` over an
+  :class:`Int8Param` (+f32 bias), for checkpoint-quantized models (see
+  :func:`..parallel.auto.load_quantized`, the ``load_in_8bit`` seam).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax import struct
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+class Int8Param(struct.PyTreeNode):
+    """Per-channel symmetric int8 weight: ``w ~= q * scale``.
+
+    ``q``: int8, same shape as the original weight. ``scale``: float32,
+    shape broadcastable to ``q`` (1 everywhere except the channel axis).
+    """
+
+    q: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequantize(self) -> jax.Array:
+        return self.q.astype(jnp.float32) * self.scale
+
+
+def quantize_int8(w: jax.Array, channel_axis: int = -1) -> Int8Param:
+    """absmax/127 per-channel symmetric quantization (the bitsandbytes
+    vector-wise scheme). ``channel_axis`` is the output-feature axis that
+    keeps its own scale (-1 for a Dense kernel (in, out))."""
+    w = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(
+        a for a in range(w.ndim) if a != channel_axis % w.ndim
+    )
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return Int8Param(q=q, scale=scale)
+
+
+def _int8_matmul_kernel(x_ref, q_ref, sw_ref, out_ref):
+    """One (TM, TN) output tile: row-quantize x, int8 MXU matmul, dequant."""
+    x = x_ref[:].astype(jnp.float32)  # (TM, K)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)  # (TM, 1)
+    sx = jnp.maximum(absmax, 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(x / sx), -127, 127).astype(jnp.int8)
+    acc = jnp.dot(
+        xq, q_ref[:], preferred_element_type=jnp.int32
+    )  # int8 x int8 -> int32 on the MXU
+    out_ref[:] = acc.astype(jnp.float32) * sx * sw_ref[:]
+
+
+def int8_matmul(
+    x: jax.Array,
+    w: Int8Param,
+    *,
+    block_m: int = 256,
+    block_n: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``x @ (q * scale)`` with dynamic per-row int8 activation quantization.
+
+    ``x``: (M, K) float; ``w.q``: (K, N) int8 with per-column ``w.scale``.
+    M is padded to the tile size internally; K and N must be multiples of
+    the TPU lane/sublane tiling (128 and the int8 sublane 32 — true for
+    every transformer dim here). ``interpret=None`` auto-selects interpreter
+    mode off-TPU so the same code path tests on CPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k = x.shape
+    kq, n = w.q.shape
+    assert k == kq, (x.shape, w.q.shape)
+    if tuple(w.scale.shape) not in ((1, n), (n,)):
+        raise ValueError(
+            f"int8_matmul needs per-output-column scales of size {n} "
+            f"(quantize with channel_axis=-1); got scale shape "
+            f"{tuple(w.scale.shape)}"
+        )
+    scale_row = w.scale.reshape(1, n).astype(jnp.float32)
+
+    block_m = min(block_m, max(8, m))
+    block_n = min(block_n, n)
+    # pad both grid dims to tile multiples; padded columns use scale 1 and
+    # q 0 (contribute nothing) and are sliced away below
+    pad_m = (-m) % block_m
+    pad_n = (-n) % block_n
+    if pad_m:
+        x = jnp.pad(x, ((0, pad_m), (0, 0)))
+    q = w.q
+    if pad_n:
+        q = jnp.pad(q, ((0, 0), (0, pad_n)))
+        scale_row = jnp.pad(
+            scale_row, ((0, 0), (0, pad_n)), constant_values=1.0
+        )
+    mp, np_ = m + pad_m, n + pad_n
+
+    out = pl.pallas_call(
+        _int8_matmul_kernel,
+        grid=(mp // block_m, np_ // block_n),
+        in_specs=[
+            pl.BlockSpec(
+                (block_m, k), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (k, block_n), lambda i, j: (0, j), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, block_n), lambda i, j: (0, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_m, block_n), lambda i, j: (i, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        interpret=interpret,
+    )(x.astype(jnp.float32), q, scale_row)
+    return out[:m, :n] if (pad_m or pad_n) else out
+
+
+def int8_matmul_reference(x: jax.Array, w: Int8Param) -> jax.Array:
+    """Pure-jnp statement of the kernel's math (for tests and off-TPU use)."""
+    x = jnp.asarray(x, jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    sx = jnp.maximum(absmax, 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(x / sx), -127, 127).astype(jnp.int8)
+    acc = jnp.dot(xq, w.q, preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * sx * w.scale.reshape(1, -1)
+
+
+class Int8Dense(nn.Module):
+    """Serving twin of ``nn.Dense`` over int8 weights.
+
+    Parameters are ``q`` (int8 kernel), ``scale`` (per-output-column), and
+    optionally ``bias`` — the tree produced by quantizing a trained Dense
+    kernel (:func:`quantize_int8` / :func:`..parallel.auto.load_quantized`).
+    Zero-initialized when built fresh: this module is for loading quantized
+    checkpoints, not training (int8 has no useful gradient).
+    """
+
+    features: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        k = x.shape[-1]
+        q = self.param(
+            "q", nn.initializers.zeros, (k, self.features), jnp.int8
+        )
+        scale = self.param(
+            "scale", nn.initializers.ones, (1, self.features), jnp.float32
+        )
+        lead = x.shape[:-1]
+        out = int8_matmul(
+            x.reshape(-1, k), Int8Param(q=q, scale=scale)
+        )
+        out = out.reshape(*lead, self.features)
+        if self.use_bias:
+            out = out + self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            )
+        return out.astype(x.dtype)

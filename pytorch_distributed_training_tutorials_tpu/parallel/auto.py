@@ -24,6 +24,13 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 
+def _keystr(key_path) -> str:
+    """'block/attn/kernel'-style path string from a tree_map_with_path key."""
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
+    )
+
+
 def save_checkpoint(path: str | os.PathLike, tree) -> None:
     """Write a pytree (params / full train-state) as a sharded checkpoint.
 
@@ -71,6 +78,40 @@ def load_sharded(
         return ckptr.restore(path, abstract)
 
 
+def load_quantized(
+    path: str | os.PathLike,
+    should_quantize: Callable[[str, np.ndarray], bool] | None = None,
+    channel_axis: int = -1,
+):
+    """Restore a checkpoint with selected weights quantized to int8 on load.
+
+    The ``load_in_8bit=True`` twin (reference ``03.model_parallel.ipynb``
+    cell 2, SURVEY.md C13): matmul weights come back as
+    :class:`..ops.quant.Int8Param` (int8 values + per-channel float32
+    scales, 1/4 the HBM) while norms/biases/embeddings stay float — the same
+    mixed-precision layout the tutorial's param audit shows (cell 4).
+
+    ``should_quantize(path_str, leaf) -> bool`` selects the weights; the
+    default quantizes every rank->=2 leaf whose path ends in ``kernel``.
+    Serve the result with :class:`..ops.quant.Int8Dense`-style modules or
+    by calling ``.dequantize()`` at use sites.
+    """
+    from pytorch_distributed_training_tutorials_tpu.ops.quant import quantize_int8
+
+    if should_quantize is None:
+        def should_quantize(p, leaf):  # noqa: F811
+            return p.endswith("kernel") and getattr(leaf, "ndim", 0) >= 2
+
+    tree = restore_checkpoint(path)
+
+    def visit(kp, leaf):
+        if should_quantize(_keystr(kp), leaf):
+            return quantize_int8(leaf, channel_axis=channel_axis)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(visit, tree)
+
+
 def audit_placement(tree) -> list[str]:
     """Per-leaf device/dtype audit lines.
 
@@ -80,7 +121,7 @@ def audit_placement(tree) -> list[str]:
     lines = []
 
     def visit(kp, leaf):
-        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+        name = _keystr(kp)
         if isinstance(leaf, jax.Array):
             devs = sorted(d.id for d in leaf.devices())
             lines.append(f"{name}: {leaf.shape} {leaf.dtype} on devices {devs}")
