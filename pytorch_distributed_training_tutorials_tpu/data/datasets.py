@@ -110,17 +110,25 @@ def synthetic_lm(
 
 
 def _synthetic_images(
-    n: int, shape: tuple[int, ...], num_classes: int, seed: int
+    n: int,
+    shape: tuple[int, ...],
+    num_classes: int,
+    template_seed: int,
+    noise_seed: int,
 ) -> ArrayDataset:
     """Deterministic class-separable surrogate for an image dataset.
 
-    Each class gets a fixed random template; samples are template + noise, so a
-    real model can actually learn (loss decreases, accuracy rises) — this keeps
-    convergence tests meaningful without network access.
+    Each class gets a fixed random template; samples are template + noise, so
+    a real model can actually learn (loss decreases, accuracy rises) — this
+    keeps convergence tests meaningful without network access. The templates
+    are seeded separately from the noise so train/test splits share one
+    underlying distribution (same classes, fresh samples) — otherwise
+    evaluation on the test split would be noise.
     """
-    rng = np.random.Generator(np.random.PCG64(seed))
+    t_rng = np.random.Generator(np.random.PCG64(template_seed))
+    templates = t_rng.standard_normal((num_classes, *shape)).astype(np.float32)
+    rng = np.random.Generator(np.random.PCG64(noise_seed))
     labels = rng.integers(0, num_classes, size=n).astype(np.int32)
-    templates = rng.standard_normal((num_classes, *shape)).astype(np.float32)
     images = templates[labels] * 0.5 + 0.5 * rng.standard_normal(
         (n, *shape)
     ).astype(np.float32)
@@ -153,9 +161,12 @@ def mnist(split: str = "train", data_dir: str | None = None) -> ArrayDataset:
             labels = _read_idx(lbl_p).astype(np.int32)
             return ArrayDataset((images, labels))
     n = 60000 if split == "train" else 10000
-    # Fixed per-split constants: hash() is interpreter-randomized and would
-    # desync the surrogate across processes/runs.
-    return _synthetic_images(n, (28, 28, 1), 10, seed=1 if split == "train" else 2)
+    # Fixed constants: hash() is interpreter-randomized and would desync the
+    # surrogate across processes/runs. Shared template seed across splits.
+    return _synthetic_images(
+        n, (28, 28, 1), 10, template_seed=101,
+        noise_seed=1 if split == "train" else 2,
+    )
 
 
 def cifar10(split: str = "train", data_dir: str | None = None) -> ArrayDataset:
@@ -191,4 +202,7 @@ def cifar10(split: str = "train", data_dir: str | None = None) -> ArrayDataset:
         )
         return ArrayDataset((images, np.asarray(ys, dtype=np.int32)))
     n = 50000 if split == "train" else 10000
-    return _synthetic_images(n, (32, 32, 3), 10, seed=3 if split == "train" else 4)
+    return _synthetic_images(
+        n, (32, 32, 3), 10, template_seed=103,
+        noise_seed=3 if split == "train" else 4,
+    )

@@ -103,7 +103,10 @@ def int8_matmul(
         )
     scale_row = w.scale.reshape(1, n).astype(jnp.float32)
 
+    # sublane alignment: f32 blocks need second-to-last dim % 8 == 0 on real
+    # TPU (interpret mode would hide a violation)
     block_m = min(block_m, max(8, m))
+    block_m = -(-block_m // 8) * 8
     block_n = min(block_n, n)
     # pad both grid dims to tile multiples; padded columns use scale 1 and
     # q 0 (contribute nothing) and are sliced away below

@@ -95,6 +95,11 @@ def load_quantized(
     default quantizes every rank->=2 leaf whose path ends in ``kernel``.
     Serve the result with :class:`..ops.quant.Int8Dense`-style modules or
     by calling ``.dequantize()`` at use sites.
+
+    Memory note: the float checkpoint is restored to *host* RAM in full
+    before quantization (devices only ever see the int8 tree), so peak host
+    usage is the f32 checkpoint size. A streaming per-leaf restore that
+    bounds host peak at the largest single leaf is future work.
     """
     from pytorch_distributed_training_tutorials_tpu.ops.quant import quantize_int8
 

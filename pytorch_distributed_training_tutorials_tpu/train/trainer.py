@@ -168,8 +168,12 @@ def make_train_step(
     return jax.jit(step_fn, donate_argnums=0)
 
 
-def make_eval_step(has_batch_stats: bool = False):
-    """Jitted eval step: per-batch (sum CE loss, correct count)."""
+def make_eval_step(loss: str = "cross_entropy", has_batch_stats: bool = False):
+    """Jitted eval step: per-batch (summed per-sample loss, correct count).
+
+    ``correct`` is an argmax-accuracy count for integer-label cross-entropy
+    and 0 otherwise (regression has no accuracy).
+    """
 
     def eval_fn(state: TrainState, batch):
         x, y = batch
@@ -179,11 +183,20 @@ def make_eval_step(has_batch_stats: bool = False):
             logits = state.apply_fn(variables, x, train=False)
         else:
             logits = state.apply_fn(variables, x)
-        loss_sum = optax.softmax_cross_entropy_with_integer_labels(
-            logits, y
-        ).sum()
-        correct = jnp.sum(jnp.argmax(logits, -1) == y)
-        return loss_sum, correct
+        classification = loss == "cross_entropy" and y.ndim < logits.ndim
+        if classification:
+            # per-label stats (for an LM, labels = every token position)
+            loss_sum = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y
+            ).sum()
+            correct = jnp.sum(jnp.argmax(logits, -1) == y)
+            count = y.size
+        else:
+            # batch-mean loss scaled back to a sum; accuracy undefined
+            loss_sum = _compute_loss(loss, logits, y) * y.shape[0]
+            correct = jnp.zeros((), jnp.int32)
+            count = y.shape[0]
+        return loss_sum, correct, count
 
     return jax.jit(eval_fn)
 
@@ -225,7 +238,10 @@ class Trainer:
             aux_loss_weight=aux_loss_weight,
         )
         self.log_every = log_every
+        self.loss_name = loss
         self.last_epoch_metrics: dict = {}
+        self.epoch = 0  # next epoch to run; advanced by train(), restored
+        self._eval_step = None
 
     def _run_epoch(self, epoch: int) -> dict:
         self.loader.set_epoch(epoch)  # reference ddp_gpus.py:45
@@ -267,7 +283,83 @@ class Trainer:
         return m
 
     def train(self, max_epochs: int) -> dict:
-        """Run ``max_epochs`` epochs (reference ``ddp_gpus.py:51-53``)."""
-        for epoch in range(max_epochs):
+        """Run up to epoch ``max_epochs`` (reference ``ddp_gpus.py:51-53``).
+
+        Starts from ``self.epoch``, so a trainer restored from a checkpoint
+        continues where it left off instead of retraining from scratch (the
+        reference is restart-safe only by being stateless — SURVEY.md
+        section 5.3/5.4; this closes that gap).
+        """
+        if self.epoch >= max_epochs:
+            log0(
+                f"train: already at epoch {self.epoch} >= {max_epochs}, "
+                "nothing to run"
+            )
+            return {
+                "epoch": self.epoch, "loss": float("nan"), "steps": 0,
+                "skipped": True,
+            }
+        for epoch in range(self.epoch, max_epochs):
             self.last_epoch_metrics = self._run_epoch(epoch)
+            self.epoch = epoch + 1
         return self.last_epoch_metrics
+
+    # -- checkpoint / resume (SURVEY.md section 5.4 gap fix) ---------------
+    def _state_tree(self) -> dict:
+        tree = {
+            "step": self.state.step,
+            "params": self.state.params,
+            "opt_state": self.state.opt_state,
+            "epoch": jnp.asarray(self.epoch, jnp.int32),
+        }
+        if self.has_batch_stats:
+            tree["batch_stats"] = self.state.batch_stats
+        return tree
+
+    def save(self, path) -> None:
+        """Sharded checkpoint of params/optimizer/step/epoch (orbax —
+        each host writes only its addressable shards)."""
+        from pytorch_distributed_training_tutorials_tpu.parallel.auto import (
+            save_checkpoint,
+        )
+
+        save_checkpoint(path, self._state_tree())
+
+    def restore(self, path) -> None:
+        """Restore in place, preserving the current sharding layout (the
+        template tree's shardings drive orbax's placement)."""
+        from pytorch_distributed_training_tutorials_tpu.parallel.auto import (
+            restore_checkpoint,
+        )
+
+        restored = restore_checkpoint(path, like=self._state_tree())
+        self.epoch = int(restored.pop("epoch"))
+        self.state = self.state.replace(**restored)
+
+    # -- evaluation (the reference never evaluates — SURVEY.md 5.5) --------
+    def evaluate(self, eval_loader=None) -> dict:
+        """Mean loss (the trainer's configured loss) + accuracy (for
+        integer-label classification; 0.0 otherwise) over ``eval_loader``
+        (default: the training loader). Wrap-padded duplicate rows
+        (equal-shard padding) are counted like the reference's
+        DistributedSampler would."""
+        loader = eval_loader if eval_loader is not None else self.loader
+        if self._eval_step is None:
+            self._eval_step = make_eval_step(
+                self.loss_name, self.has_batch_stats
+            )
+        loss_sum = 0.0
+        correct = 0
+        seen = 0
+        for batch in loader:
+            if not isinstance(batch, tuple) or len(batch) != 2:
+                raise ValueError("evaluate() requires (x, y) batches")
+            ls, c, n = self._eval_step(self.state, batch)
+            loss_sum += float(ls)
+            correct += int(c)
+            seen += int(n)
+        return {
+            "loss": loss_sum / max(seen, 1),
+            "accuracy": correct / max(seen, 1),
+            "samples": seen,
+        }

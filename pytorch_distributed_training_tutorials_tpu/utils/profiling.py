@@ -1,0 +1,43 @@
+"""Profiling: jax.profiler tracing around the hot loop.
+
+The reference declares profilers (py-spy, memory-profiler,
+``environment.yml:78-79``) but never uses them; its only timing is naive
+``timeit`` (SURVEY.md section 5.1), which lies under XLA's async dispatch.
+This module is the gap fix: :func:`trace` captures a real device trace
+(XLA ops, ICI collectives, host callbacks) viewable in TensorBoard/Perfetto,
+and :func:`annotate` marks host-side regions so loader/step boundaries show
+up in the timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str = "/tmp/jax-trace"):
+    """Capture a device+host profiler trace of the enclosed region.
+
+    Usage::
+
+        with profiling.trace("/tmp/tr"):
+            trainer.train(1)
+
+    View with ``tensorboard --logdir /tmp/tr`` (or load the ``.trace.json.gz``
+    in Perfetto). Wrap *steady-state* steps — the first step's compile time
+    dominates a cold trace.
+    """
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named host-side region for the trace timeline (context manager)."""
+    return jax.profiler.TraceAnnotation(name)
