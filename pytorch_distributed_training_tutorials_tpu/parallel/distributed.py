@@ -59,17 +59,18 @@ def init(
     if _initialized:
         return
 
-    env_driven = any(
-        k in os.environ
-        for k in (
-            "JAX_COORDINATOR_ADDRESS",
-            "JAX_NUM_PROCESSES",
-            "JAX_PROCESS_ID",
-            "COORDINATOR_ADDRESS",
-            "TPU_WORKER_HOSTNAMES",
-            "MEGASCALE_COORDINATOR_ADDRESS",
-        )
-    )
+    env_keys = [
+        "JAX_COORDINATOR_ADDRESS",
+        "JAX_NUM_PROCESSES",
+        "JAX_PROCESS_ID",
+        "COORDINATOR_ADDRESS",
+    ]
+    # TPU pod metadata only counts as a topology signal when we're actually
+    # going to run on TPU — a CPU-forced run (tests, notebooks) on a TPU VM
+    # must not try to rendezvous against the pod runtime.
+    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        env_keys += ["TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS"]
+    env_driven = any(k in os.environ for k in env_keys)
     explicit = coordinator_address is not None or num_processes is not None
 
     if not explicit and not env_driven:

@@ -295,10 +295,13 @@ class Trainer:
                 f"train: already at epoch {self.epoch} >= {max_epochs}, "
                 "nothing to run"
             )
-            return {
+            # same key shape as a real epoch so metric consumers don't branch
+            self.last_epoch_metrics = {
                 "epoch": self.epoch, "loss": float("nan"), "steps": 0,
+                "steps_per_sec": 0.0, "samples_per_sec": 0.0,
                 "skipped": True,
             }
+            return self.last_epoch_metrics
         for epoch in range(self.epoch, max_epochs):
             self.last_epoch_metrics = self._run_epoch(epoch)
             self.epoch = epoch + 1
@@ -306,11 +309,15 @@ class Trainer:
 
     # -- checkpoint / resume (SURVEY.md section 5.4 gap fix) ---------------
     def _state_tree(self) -> dict:
+        import numpy as np
+
         tree = {
             "step": self.state.step,
             "params": self.state.params,
             "opt_state": self.state.opt_state,
-            "epoch": jnp.asarray(self.epoch, jnp.int32),
+            # host scalar, not a device array: a per-process
+            # SingleDeviceSharding leaf would break multi-host orbax saves
+            "epoch": np.asarray(self.epoch, np.int32),
         }
         if self.has_batch_stats:
             tree["batch_stats"] = self.state.batch_stats
@@ -348,16 +355,19 @@ class Trainer:
             self._eval_step = make_eval_step(
                 self.loss_name, self.has_batch_stats
             )
-        loss_sum = 0.0
-        correct = 0
-        seen = 0
+        # accumulate device arrays; convert once after the loop so eval
+        # dispatch stays async (a float() per batch would sync every step)
+        losses, corrects, counts = [], [], []
         for batch in loader:
             if not isinstance(batch, tuple) or len(batch) != 2:
                 raise ValueError("evaluate() requires (x, y) batches")
             ls, c, n = self._eval_step(self.state, batch)
-            loss_sum += float(ls)
-            correct += int(c)
-            seen += int(n)
+            losses.append(ls)
+            corrects.append(c)
+            counts.append(n)
+        loss_sum = float(sum(float(l) for l in jax.device_get(losses)))
+        correct = int(sum(int(c) for c in jax.device_get(corrects)))
+        seen = int(sum(int(n) for n in jax.device_get(counts)))
         return {
             "loss": loss_sum / max(seen, 1),
             "accuracy": correct / max(seen, 1),

@@ -1,0 +1,18 @@
+"""Shared test fixtures-as-functions (imported, not auto-injected)."""
+
+import numpy as np
+
+from pytorch_distributed_training_tutorials_tpu.data.datasets import ArrayDataset
+
+
+def make_cls_dataset(n=256, dim=16, classes=4, seed=0, noise=0.1):
+    """Class-separable synthetic classification data: fixed random class
+    centers + gaussian noise (the same recipe as datasets._synthetic_images,
+    in flat-feature form)."""
+    rng = np.random.Generator(np.random.PCG64(seed))
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    centers = rng.standard_normal((classes, dim)).astype(np.float32) * 3
+    x = centers[labels] + noise * rng.standard_normal((n, dim)).astype(
+        np.float32
+    )
+    return ArrayDataset((x, labels))

@@ -6,27 +6,18 @@ import os
 import numpy as np
 import optax
 
-from pytorch_distributed_training_tutorials_tpu.data import (
-    ArrayDataset,
-    ShardedLoader,
-)
+from helpers import make_cls_dataset
+
+from pytorch_distributed_training_tutorials_tpu.data import ShardedLoader
 from pytorch_distributed_training_tutorials_tpu.models import MLP
 from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
 from pytorch_distributed_training_tutorials_tpu.train import Trainer
 from pytorch_distributed_training_tutorials_tpu.utils import profiling
 
 
-def _cls_dataset(n=256, dim=16, classes=4, seed=0):
-    rng = np.random.Generator(np.random.PCG64(seed))
-    labels = rng.integers(0, classes, n).astype(np.int32)
-    centers = rng.standard_normal((classes, dim)).astype(np.float32) * 3
-    x = centers[labels] + 0.1 * rng.standard_normal((n, dim)).astype(np.float32)
-    return ArrayDataset((x, labels))
-
-
 def _trainer(seed=0):
     mesh = create_mesh({"data": 8})
-    loader = ShardedLoader(_cls_dataset(), 8, mesh, seed=0)
+    loader = ShardedLoader(make_cls_dataset(), 8, mesh, seed=0)
     return Trainer(
         MLP(features=(32, 4)), loader, optax.adam(1e-3),
         loss="cross_entropy", seed=seed,

@@ -52,15 +52,10 @@ def test_ddp_gpus_workload_end_to_end():
 
 
 def test_loss_decreases_mlp_classification():
-    mesh = create_mesh({"data": 8})
-    rng = np.random.Generator(np.random.PCG64(0))
-    n = 1024
-    labels = rng.integers(0, 4, n).astype(np.int32)
-    centers = rng.standard_normal((4, 16)).astype(np.float32) * 3
-    x = centers[labels] + rng.standard_normal((n, 16)).astype(np.float32) * 0.1
-    from pytorch_distributed_training_tutorials_tpu.data.datasets import ArrayDataset
+    from helpers import make_cls_dataset
 
-    loader = ShardedLoader(ArrayDataset((x, labels)), 16, mesh)
+    mesh = create_mesh({"data": 8})
+    loader = ShardedLoader(make_cls_dataset(n=1024), 16, mesh)
     trainer = Trainer(
         MLP(features=(64, 4)), loader, optax.adam(1e-3), loss="cross_entropy"
     )
