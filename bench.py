@@ -3,30 +3,46 @@
 The BASELINE.json north-star (``BASELINE.json:2``): data-parallel ResNet-18 on
 MNIST, reported per chip. The reference publishes no numbers
 (``BASELINE.json:13``), so ``vs_baseline`` is reported against
-``BASELINE_IMAGES_PER_SEC_PER_CHIP`` below — set from this repo's first
-recorded TPU run so later rounds measure improvement against round 1.
+``BASELINE_IMAGES_PER_SEC_PER_CHIP`` below — this repo's first recorded TPU
+run, so later rounds measure improvement against round 1.
 
-Prints exactly one JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+The headline number is the **end-to-end training loop** including the input
+pipeline — not a cached batch replayed. The input pipeline is the
+device-resident one (``data/resident.py``): the dataset is placed in HBM
+once, and each epoch is a single jitted ``lax.scan`` whose body gathers the
+step's batch on device (the TPU-idiomatic shape for datasets far smaller
+than HBM; on the tunneled runtime it is also ~3x faster end-to-end than
+per-step dispatch). The JSON line carries the honesty metadata: whether the
+data was a synthetic surrogate (no network egress in the build env) and a
+breakdown (streaming input pipeline alone, train step alone) so a host-side
+bottleneck is visible rather than hidden.
+
+Prints exactly one JSON line on stdout
+(``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``);
+progress/epoch lines go to stderr.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
+import sys
 
 # Round-1 first honest measurement on one TPU v5e chip (bf16 compute,
 # slope-timed to cancel the axon tunnel's async dispatch + roundtrip latency).
-# Later rounds divide by this to show the trend.
+# Round-1 measured the train step on a cached batch; from round 2 the headline
+# includes the input pipeline. Later rounds divide by this to show the trend.
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 46400.0
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
-    import numpy as np
     import optax
 
+    from pytorch_distributed_training_tutorials_tpu.bench.harness import slope_time
     from pytorch_distributed_training_tutorials_tpu.data import (
+        DeviceResidentLoader,
         ShardedLoader,
         mnist,
     )
@@ -34,6 +50,7 @@ def main() -> None:
     from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
     from pytorch_distributed_training_tutorials_tpu.train import (
         Trainer,
+        make_train_step,
     )
 
     mesh = create_mesh()
@@ -41,35 +58,77 @@ def main() -> None:
     per_device_batch = 256
 
     ds = mnist("train")
-    loader = ShardedLoader(ds, per_device_batch, mesh, seed=0)
+    loader = DeviceResidentLoader(ds, per_device_batch, mesh, seed=0)
     model = resnet18(num_classes=10, stem="cifar", dtype=jnp.bfloat16)
     trainer = Trainer(
         model, loader, optax.sgd(0.05, momentum=0.9), loss="cross_entropy"
     )
 
-    batch = next(iter(loader))
+    with contextlib.redirect_stdout(sys.stderr):
+        # Epoch 0 compiles and warms every cache; epochs 1-2 are the honest
+        # end-to-end measurement (dataset residency + on-device gather +
+        # train step, synced by the host fetch of the final loss).
+        trainer._run_epoch(0)
+        e2e = max(
+            trainer._run_epoch(epoch)["samples_per_sec"] for epoch in (1, 2)
+        )
 
-    def run(k: int) -> None:
-        # k chained steps ending in a host fetch (slope_time contract)
-        last = None
-        for _ in range(k):
-            trainer.state, last = trainer.train_step(trainer.state, batch)
-        float(last["loss"])
+        # Breakdown leg 1: the *streaming* input pipeline (native C++ row
+        # gather + per-batch H2D), one full pass, no compute — what a
+        # larger-than-HBM dataset would pay on the host side.
+        import time
 
-    from pytorch_distributed_training_tutorials_tpu.bench.harness import slope_time
+        streaming = ShardedLoader(ds, per_device_batch, mesh, seed=0)
+        t0 = time.perf_counter()
+        n_batches = 0
+        for batch in streaming:
+            jax.block_until_ready(batch)
+            n_batches += 1
+        input_images_s = n_batches * streaming.global_batch / (
+            time.perf_counter() - t0
+        )
 
-    sec_per_step = slope_time(run, n1=5, n2=25, warmup=3)
-    images_per_sec = loader.global_batch / sec_per_step
-    per_chip = images_per_sec / n_chips
+        # Breakdown leg 2: train step alone on a cached batch (the round-1
+        # measurement) — the device-side ceiling for per-step dispatch.
+        batch = next(iter(streaming))
+        step = make_train_step(loss="cross_entropy", has_batch_stats=True)
+        state = trainer.state
+
+        def run(k: int) -> None:
+            nonlocal state
+            last = None
+            for _ in range(k):
+                state, last = step(state, batch)
+            float(last["loss"])
+
+        step_images_s = streaming.global_batch / slope_time(
+            run, n1=5, n2=25, warmup=3
+        )
+
+    per_chip = e2e / n_chips
     print(
         json.dumps(
             {
-                "metric": "images/sec/chip (ResNet-18 MNIST, data-parallel train)",
+                "metric": (
+                    "images/sec/chip (ResNet-18 MNIST, data-parallel train, "
+                    "end-to-end incl. input pipeline)"
+                ),
                 "value": round(per_chip, 1),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(
                     per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3
                 ),
+                "synthetic": bool(ds.synthetic),
+                "n_chips": n_chips,
+                "per_device_batch": per_device_batch,
+                "breakdown": {
+                    "input_pipeline_images_per_sec_per_chip": round(
+                        input_images_s / n_chips, 1
+                    ),
+                    "train_step_only_images_per_sec_per_chip": round(
+                        step_images_s / n_chips, 1
+                    ),
+                },
             }
         )
     )

@@ -23,3 +23,6 @@ from pytorch_distributed_training_tutorials_tpu.data.loader import (  # noqa: F4
 from pytorch_distributed_training_tutorials_tpu.data.prefetch import (  # noqa: F401
     PrefetchLoader,
 )
+from pytorch_distributed_training_tutorials_tpu.data.resident import (  # noqa: F401
+    DeviceResidentLoader,
+)

@@ -1,0 +1,90 @@
+"""Device-resident dataset: whole epochs as one compiled program.
+
+The reference streams every batch host->device per step
+(``ddp_gpus.py:46-48``: DataLoader iteration + ``.to(gpu)``). On TPU that
+per-step Python dispatch is the wrong shape twice over: each step is a
+separate XLA program launch, and on tunneled/remote runtimes the per-call
+overhead compounds (measured: the per-step path degrades ~15x once a few
+hundred dispatches are in flight). For datasets that fit in HBM — MNIST is
+188 MB, CIFAR-10 614 MB, against 16 GB on one v5e — the TPU-idiomatic input
+pipeline is:
+
+1. put the dataset arrays on device **once** (replicated over the mesh),
+2. compute the epoch's `(steps, global_batch)` index matrix on host with the
+   exact DistributedSampler semantics (shuffle seeded by epoch, wrap-padded
+   equal shards — ``sampler.py``),
+3. run the whole epoch as **one** jitted ``lax.scan`` whose body gathers the
+   step's batch from the resident arrays and applies the train step; the
+   gather + normalize fuse into the step's first convolution.
+
+This keeps every observable the reference defines — per-device batch-size
+meaning, steps-per-epoch math, ``set_epoch`` reshuffle — while replacing ~235
+program launches per MNIST epoch with one. ``ShardedLoader`` remains the
+streaming path for datasets that don't fit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from pytorch_distributed_training_tutorials_tpu.data.datasets import ArrayDataset
+from pytorch_distributed_training_tutorials_tpu.data.loader import ShardedLoader
+
+
+class DeviceResidentLoader(ShardedLoader):
+    """A :class:`ShardedLoader` whose dataset lives in device memory.
+
+    Iterating it yields batches like the parent (so everything written
+    against the streaming loader still works), but trainers that know about
+    ``device_arrays`` / :meth:`epoch_index_array` run the epoch as a single
+    ``lax.scan`` instead.
+
+    ``transform`` (optional) is applied to the gathered batch tuple *on
+    device inside the compiled epoch* — e.g. uint8 images to normalized
+    float: ``lambda x, y: (x.astype(jnp.float32) / 255.0, y)``.
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        batch_size: int,
+        mesh: Mesh,
+        *,
+        transform=None,
+        **kwargs,
+    ):
+        if kwargs.get("batch_spec") is not None:
+            raise NotImplementedError(
+                "DeviceResidentLoader shards batches over the data axis only; "
+                "use ShardedLoader for custom batch_specs (e.g. sequence "
+                "parallelism)"
+            )
+        super().__init__(dataset, batch_size, mesh, **kwargs)
+        self.transform = transform
+        # Replicated residency: every device holds the dataset, so the
+        # per-step gather is local (no collectives). Tutorial-scale datasets
+        # are far smaller than HBM; shard-over-data residency is the natural
+        # extension when they aren't.
+        rep = NamedSharding(mesh, PartitionSpec())
+        self.device_arrays = tuple(
+            jax.device_put(a, rep) for a in dataset.arrays
+        )
+
+    def epoch_index_array(self, epoch: int) -> jax.Array:
+        """The epoch's ``(steps, global_batch)`` int32 index matrix, on
+        device, sharded so each data-parallel replica holds exactly its own
+        per-step indices (dim 1 over the data axis, replica-major order —
+        identical to the streaming loader's batch layout)."""
+        self.set_epoch(epoch)
+        shards = self._epoch_index_matrix()  # (world, steps * bs)
+        idx = (
+            shards.reshape(self.world, self.steps_per_epoch, self.per_device_batch)
+            .transpose(1, 0, 2)
+            .reshape(self.steps_per_epoch, self.global_batch)
+            .astype(np.int32)
+        )
+        sharding = NamedSharding(self.mesh, PartitionSpec(None, self.axis))
+        return jax.device_put(idx, sharding)

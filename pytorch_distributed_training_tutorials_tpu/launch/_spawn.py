@@ -64,6 +64,30 @@ def _worker_env(
     return env
 
 
+def _bootstrap(env_delta: dict, target: Callable, rank: int, args: Sequence):
+    """Child-process entry: apply the env delta *inside the child* (before
+    jax import/init in ``target``), then run ``target(rank, *args)``.
+
+    Keeping the delta out of the parent's ``os.environ`` means concurrent
+    ``spawn()`` calls (or other parent threads reading env mid-launch) can
+    never observe another rank's ``JAX_PROCESS_ID``/``JAX_PLATFORMS``.
+    """
+    for k, v in env_delta.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    if env_delta.get("JAX_PLATFORMS"):
+        # This build's sitecustomize pre-imports jax._src at interpreter
+        # startup — before this function runs — so the env var alone can be
+        # captured too late; forward it through the config API as well
+        # (backends are not initialized yet; same pattern as tests/conftest).
+        import jax
+
+        jax.config.update("jax_platforms", env_delta["JAX_PLATFORMS"])
+    target(rank, *args)
+
+
 def spawn(
     target: Callable,
     nprocs: int,
@@ -92,24 +116,18 @@ def spawn(
     coordinator = coordinator or f"localhost:{pick_unused_port()}"
     ctx = mp.get_context("spawn")
     procs: list[mp.Process] = []
-    saved: dict[str, str | None] = {}
     try:
         for rank in range(nprocs):
-            # Children inherit os.environ at start(); stage each child's env
-            # delta, then restore the parent's view.
+            # Each child's env delta rides the process args and is applied by
+            # _bootstrap inside the child — the parent's env is never touched.
             delta = _worker_env(
                 rank, nprocs, coordinator, platform, env_contract,
                 devices_per_process,
             )
-            for k, v in delta.items():
-                if k not in saved:
-                    saved[k] = os.environ.get(k)
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
             p = ctx.Process(
-                target=target, args=(rank, *args), name=f"spawn-rank{rank}"
+                target=_bootstrap,
+                args=(delta, target, rank, tuple(args)),
+                name=f"spawn-rank{rank}",
             )
             p.start()
             procs.append(p)
@@ -121,12 +139,6 @@ def spawn(
                 p.terminate()
             p.join(10)
         raise
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
 
     failed: list[tuple[int, int | None]] = []
     for rank, p in enumerate(procs):

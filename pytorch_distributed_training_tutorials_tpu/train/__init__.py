@@ -5,5 +5,6 @@ from pytorch_distributed_training_tutorials_tpu.train.trainer import (  # noqa: 
     TrainState,
     create_train_state,
     make_train_step,
+    make_epoch_scan,
     make_eval_step,
 )

@@ -109,22 +109,14 @@ def _compute_loss(loss: str, logits, targets):
     raise ValueError(f"unknown loss {loss!r}")
 
 
-def make_train_step(
+def _train_step_fn(
     loss: str = "cross_entropy",
     has_batch_stats: bool = False,
     aux_loss_weight: float = 0.0,
 ):
-    """Build the jitted SPMD train step (donated state).
-
-    One compiled program per step replaces the reference's
-    zero_grad/forward/loss/backward/allreduce/step sequence
-    (``ddp_gpus.py:34-39``). Gradients come out replicated — XLA inserts the
-    ICI allreduce during the backward because params are replicated while the
-    batch is sharded.
-
-    ``aux_loss_weight`` > 0 collects the model's sown ``"losses"`` collection
-    (MoE load-balancing) and adds it, weighted, to the objective.
-    """
+    """The raw (unjitted) SPMD train step, shared by :func:`make_train_step`
+    (jit per step — streaming loaders) and :func:`make_epoch_scan` (one jit
+    per epoch — device-resident datasets)."""
 
     def step_fn(state: TrainState, batch):
         x, y = batch
@@ -165,7 +157,63 @@ def make_train_step(
         )
         return new_state, {"loss": loss_val}
 
-    return jax.jit(step_fn, donate_argnums=0)
+    return step_fn
+
+
+def make_train_step(
+    loss: str = "cross_entropy",
+    has_batch_stats: bool = False,
+    aux_loss_weight: float = 0.0,
+):
+    """Build the jitted SPMD train step (donated state).
+
+    One compiled program per step replaces the reference's
+    zero_grad/forward/loss/backward/allreduce/step sequence
+    (``ddp_gpus.py:34-39``). Gradients come out replicated — XLA inserts the
+    ICI allreduce during the backward because params are replicated while the
+    batch is sharded.
+
+    ``aux_loss_weight`` > 0 collects the model's sown ``"losses"`` collection
+    (MoE load-balancing) and adds it, weighted, to the objective.
+    """
+    return jax.jit(
+        _train_step_fn(loss, has_batch_stats, aux_loss_weight),
+        donate_argnums=0,
+    )
+
+
+def make_epoch_scan(
+    loss: str = "cross_entropy",
+    has_batch_stats: bool = False,
+    aux_loss_weight: float = 0.0,
+    transform=None,
+):
+    """Build a jitted *whole-epoch* program: ``lax.scan`` of the train step
+    over a device-resident dataset.
+
+    ``epoch_fn(state, idx, data) -> (state, losses)`` where ``idx`` is the
+    epoch's ``(steps, global_batch)`` index matrix
+    (:meth:`..data.resident.DeviceResidentLoader.epoch_index_array`), ``data``
+    the resident dataset arrays, and ``losses`` the per-step loss trace. The
+    batch gather (and optional ``transform``, e.g. uint8 -> normalized float)
+    happens inside the scan body, so XLA fuses it into the step. Replaces the
+    reference's per-step ``for ... in dataloader`` hot loop
+    (``ddp_gpus.py:46-49``) with one program launch per epoch.
+    """
+    step_fn = _train_step_fn(loss, has_batch_stats, aux_loss_weight)
+
+    def epoch_fn(state: TrainState, idx, data):
+        def body(state, idx_step):
+            batch = tuple(a[idx_step] for a in data)
+            if transform is not None:
+                batch = transform(*batch)
+            state, metrics = step_fn(state, batch)
+            return state, metrics["loss"]
+
+        state, losses = jax.lax.scan(body, state, idx)
+        return state, losses
+
+    return jax.jit(epoch_fn, donate_argnums=0)
 
 
 def make_eval_step(loss: str = "cross_entropy", has_batch_stats: bool = False):
@@ -239,11 +287,55 @@ class Trainer:
         )
         self.log_every = log_every
         self.loss_name = loss
+        self.aux_loss_weight = aux_loss_weight
         self.last_epoch_metrics: dict = {}
         self.epoch = 0  # next epoch to run; advanced by train(), restored
         self._eval_step = None
+        self._epoch_scan = None
+
+    def _run_epoch_scanned(self, epoch: int) -> dict:
+        """One program launch for the whole epoch (device-resident loader)."""
+        loader = self.loader
+        if self._epoch_scan is None:
+            self._epoch_scan = make_epoch_scan(
+                loss=self.loss_name,
+                has_batch_stats=self.has_batch_stats,
+                aux_loss_weight=self.aux_loss_weight,
+                transform=loader.transform,
+            )
+        log0(
+            epoch_line(
+                self.strategy.num_devices, epoch,
+                loader.per_device_batch, len(loader),
+            )
+        )
+        idx = loader.epoch_index_array(epoch)
+        t0 = time.perf_counter()
+        self.state, losses = self._epoch_scan(
+            self.state, idx, loader.device_arrays
+        )
+        loss = float(losses[-1])  # host fetch: the honest end-of-epoch sync
+        dt = time.perf_counter() - t0
+        steps = len(loader)
+        m = {
+            "epoch": epoch,
+            "loss": loss,
+            "steps": steps,
+            "steps_per_sec": steps / dt if dt > 0 else float("inf"),
+            "samples_per_sec": steps * loader.global_batch / dt
+            if dt > 0
+            else float("inf"),
+        }
+        log0(
+            f"  epoch {epoch}: loss {m['loss']:.4f} | "
+            f"{m['steps_per_sec']:.1f} steps/s | "
+            f"{m['samples_per_sec']:.0f} samples/s"
+        )
+        return m
 
     def _run_epoch(self, epoch: int) -> dict:
+        if getattr(self.loader, "device_arrays", None) is not None:
+            return self._run_epoch_scanned(epoch)
         self.loader.set_epoch(epoch)  # reference ddp_gpus.py:45
         log0(
             epoch_line(
