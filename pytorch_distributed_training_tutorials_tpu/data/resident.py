@@ -73,6 +73,18 @@ class DeviceResidentLoader(ShardedLoader):
             jax.device_put(a, rep) for a in dataset.arrays
         )
 
+    def __iter__(self):
+        """Streaming iteration (parent semantics) with ``transform`` applied,
+        so iteration-based consumers (``Trainer.evaluate``, plain loops) see
+        the same data the compiled epoch scan trains on."""
+        for batch in super().__iter__():
+            if self.transform is None:
+                yield batch
+            elif isinstance(batch, tuple):
+                yield self.transform(*batch)
+            else:
+                yield self.transform(batch)
+
     def epoch_index_array(self, epoch: int) -> jax.Array:
         """The epoch's ``(steps, global_batch)`` int32 index matrix, on
         device, sharded so each data-parallel replica holds exactly its own

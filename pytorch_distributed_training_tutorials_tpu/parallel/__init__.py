@@ -29,6 +29,9 @@ from pytorch_distributed_training_tutorials_tpu.parallel.pipeline import (  # no
 from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import (  # noqa: F401
     TensorParallel,
 )
+from pytorch_distributed_training_tutorials_tpu.parallel.fsdp import (  # noqa: F401
+    FSDP,
+)
 
 # .auto (orbax checkpointing / auto placement) is imported lazily by users —
 # orbax is a heavyweight import and not needed on the hot path.

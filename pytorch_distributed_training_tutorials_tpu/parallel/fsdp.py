@@ -1,0 +1,132 @@
+"""FSDP / ZeRO: parameter + optimizer-state sharding over the data axis.
+
+Beyond-parity capability. The reference *declares* deepspeed and
+megatron-fsdp in its environment (``/root/reference/environment.yml:62-63``)
+but never imports either — SURVEY.md section 2 records FSDP/ZeRO as absent.
+This module makes the capability real, the TPU way:
+
+- **ZeRO-1/2** (optimizer-state + gradient sharding) and **ZeRO-3 / FSDP**
+  (parameter sharding with gather-at-use) collapse into *one* sharding
+  recipe under GSPMD: annotate every large parameter (and, via the same
+  shape-driven rule, its optimizer-state moments) as sharded over the
+  ``data`` mesh axis. XLA's sharding propagation then compiles exactly the
+  FSDP schedule — an ``all-gather`` of each weight immediately before its
+  use in forward/backward and a ``reduce-scatter`` of its gradient — and
+  overlaps both with compute, the hand-written overlap torch FSDP
+  implements in its pre-forward/post-backward hooks.
+- No wrapper module, no hooks, no flattening: models stay plain pytrees.
+  The strategy object is a drop-in for
+  :class:`.data_parallel.DataParallel` in the Trainer (same
+  ``variable_shardings`` / ``shard_state`` / ``shard_batch`` interface).
+
+Per-parameter HBM drops from ``P`` (DDP: every device holds every param,
+moment, and gradient) to ``P / world`` for everything sharded — the ZeRO-3
+memory curve.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import DATA_AXIS
+
+
+def shard_dim_for(shape: tuple[int, ...], world: int, min_size: int) -> int | None:
+    """Pick the dimension to shard over ``world`` devices, or None.
+
+    The *largest* dimension divisible by ``world`` wins (ties -> the earliest),
+    maximizing the per-device memory saving; arrays smaller than ``min_size``
+    elements stay replicated (sharding a bias of 10 floats buys nothing and
+    costs an all-gather dispatch).
+    """
+    if not shape:
+        return None
+    total = 1
+    for d in shape:
+        total *= d
+    if total < min_size:
+        return None
+    best: int | None = None
+    for i, d in enumerate(shape):
+        if d % world == 0 and (best is None or d > shape[best]):
+            best = i
+    return best
+
+
+class FSDP:
+    """Shape-driven ZeRO-3 sharding strategy over one mesh axis.
+
+    Usage (drop-in for ``DataParallel`` in the Trainer)::
+
+        mesh = create_mesh()                     # {'data': N}
+        trainer = Trainer(model, loader, tx, strategy=FSDP(mesh))
+
+    Every parameter (and optimizer moment — same shapes, same rule) with at
+    least ``min_size`` elements and a dimension divisible by the axis size is
+    sharded on that dimension; the rest replicate. Batches shard over the
+    same axis, so gradients come out reduce-scattered rather than
+    all-reduced — ZeRO's bandwidth trade.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        axis: str = DATA_AXIS,
+        *,
+        min_size: int = 1024,
+    ):
+        self.mesh = mesh
+        self.axis = axis
+        self.min_size = min_size
+        self.batch_sharding = NamedSharding(mesh, PartitionSpec(axis))
+        self._replicated = NamedSharding(mesh, PartitionSpec())
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.shape.get(self.axis, 1)
+
+    def spec_for(self, shape: tuple[int, ...]) -> PartitionSpec:
+        dim = shard_dim_for(tuple(shape), self.num_devices, self.min_size)
+        if dim is None:
+            return PartitionSpec()
+        parts: list = [None] * len(shape)
+        parts[dim] = self.axis
+        return PartitionSpec(*parts)
+
+    def _leaf_sharding(self, leaf) -> NamedSharding:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if not shape:
+            return self._replicated
+        return NamedSharding(self.mesh, self.spec_for(shape))
+
+    def variable_shardings(self, abstract_variables):
+        """Pytree of NamedShardings (the ``out_shardings`` for a sharded
+        ``model.init``) — every leaf placed by shape alone."""
+        return jax.tree_util.tree_map(self._leaf_sharding, abstract_variables)
+
+    def shard_state(self, state):
+        """Place an existing train state: params *and* optimizer moments
+        follow the shape rule (ZeRO-1's optimizer sharding falls out of
+        ZeRO-3's because optax moments mirror param shapes)."""
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, self._leaf_sharding(leaf)),
+            state,
+        )
+
+    def shard_batch(self, batch):
+        return jax.device_put(batch, self.batch_sharding)
+
+    def audit(self, params) -> list[str]:
+        """Path -> spec lines (the 03-notebook placement-audit twin)."""
+        lines: list[str] = []
+
+        def visit(kp, leaf):
+            path = "/".join(
+                str(getattr(k, "key", getattr(k, "idx", k))) for k in kp
+            )
+            spec = self.spec_for(tuple(leaf.shape))
+            lines.append(f"{path}: {tuple(leaf.shape)} -> {tuple(spec)}")
+
+        jax.tree_util.tree_map_with_path(visit, params)
+        return lines

@@ -293,6 +293,25 @@ class Trainer:
         self._eval_step = None
         self._epoch_scan = None
 
+    def _epoch_metrics(self, epoch: int, loss, steps: int, dt: float) -> dict:
+        """Shared metric dict + per-epoch log line for both epoch paths
+        (streaming and scanned) — one place defines the keys/format."""
+        m = {
+            "epoch": epoch,
+            "loss": float(loss) if loss is not None else float("nan"),
+            "steps": steps,
+            "steps_per_sec": steps / dt if dt > 0 else float("inf"),
+            "samples_per_sec": steps * self.loader.global_batch / dt
+            if dt > 0
+            else float("inf"),
+        }
+        log0(
+            f"  epoch {epoch}: loss {m['loss']:.4f} | "
+            f"{m['steps_per_sec']:.1f} steps/s | "
+            f"{m['samples_per_sec']:.0f} samples/s"
+        )
+        return m
+
     def _run_epoch_scanned(self, epoch: int) -> dict:
         """One program launch for the whole epoch (device-resident loader)."""
         loader = self.loader
@@ -316,22 +335,7 @@ class Trainer:
         )
         loss = float(losses[-1])  # host fetch: the honest end-of-epoch sync
         dt = time.perf_counter() - t0
-        steps = len(loader)
-        m = {
-            "epoch": epoch,
-            "loss": loss,
-            "steps": steps,
-            "steps_per_sec": steps / dt if dt > 0 else float("inf"),
-            "samples_per_sec": steps * loader.global_batch / dt
-            if dt > 0
-            else float("inf"),
-        }
-        log0(
-            f"  epoch {epoch}: loss {m['loss']:.4f} | "
-            f"{m['steps_per_sec']:.1f} steps/s | "
-            f"{m['samples_per_sec']:.0f} samples/s"
-        )
-        return m
+        return self._epoch_metrics(epoch, loss, len(loader), dt)
 
     def _run_epoch(self, epoch: int) -> dict:
         if getattr(self.loader, "device_arrays", None) is not None:
@@ -358,21 +362,7 @@ class Trainer:
                 log0(f"  step {steps}: loss {float(loss):.4f}")
         jax.block_until_ready(self.state.params)
         dt = time.perf_counter() - t0
-        m = {
-            "epoch": epoch,
-            "loss": float(loss) if loss is not None else float("nan"),
-            "steps": steps,
-            "steps_per_sec": steps / dt if dt > 0 else float("inf"),
-            "samples_per_sec": steps * self.loader.global_batch / dt
-            if dt > 0
-            else float("inf"),
-        }
-        log0(
-            f"  epoch {epoch}: loss {m['loss']:.4f} | "
-            f"{m['steps_per_sec']:.1f} steps/s | "
-            f"{m['samples_per_sec']:.0f} samples/s"
-        )
-        return m
+        return self._epoch_metrics(epoch, loss, steps, dt)
 
     def train(self, max_epochs: int) -> dict:
         """Run up to epoch ``max_epochs`` (reference ``ddp_gpus.py:51-53``).

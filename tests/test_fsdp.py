@@ -1,0 +1,117 @@
+"""FSDP/ZeRO strategy: sharded params/opt-state, DDP-identical numerics.
+
+The reference declares deepspeed/megatron-fsdp without using them
+(``/root/reference/environment.yml:62-63``) — these tests prove the TPU
+build's FSDP is real: parameters and optimizer moments physically shard over
+the ``data`` axis (per-device HBM drops to ~1/world), while training numerics
+match DataParallel exactly (FSDP is an execution schedule, not a different
+optimizer).
+"""
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec
+
+from pytorch_distributed_training_tutorials_tpu.data import ShardedLoader
+from pytorch_distributed_training_tutorials_tpu.models import MLP
+from pytorch_distributed_training_tutorials_tpu.parallel import DataParallel, FSDP
+from pytorch_distributed_training_tutorials_tpu.parallel.fsdp import shard_dim_for
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+from pytorch_distributed_training_tutorials_tpu.train import Trainer
+from pytorch_distributed_training_tutorials_tpu.train.trainer import (
+    create_train_state,
+    make_train_step,
+)
+
+from helpers import make_cls_dataset
+
+
+def test_shard_dim_prefers_largest_divisible():
+    assert shard_dim_for((16, 64), 8, 1) == 1  # largest divisible dim wins
+    assert shard_dim_for((64, 16), 8, 1) == 0
+    assert shard_dim_for((64, 64), 8, 1) == 0  # tie -> earliest
+    assert shard_dim_for((7, 9), 8, 1) is None  # nothing divides
+    assert shard_dim_for((8,), 8, 1024) is None  # below min_size
+    assert shard_dim_for((), 8, 1) is None  # scalar
+
+
+def test_params_and_opt_state_physically_sharded():
+    mesh = create_mesh({"data": 8})
+    fsdp = FSDP(mesh, min_size=64)
+    model = MLP(features=(128, 4))
+    x = np.zeros((8, 16), np.float32)
+    state = create_train_state(model, optax.adam(1e-3), x, strategy=fsdp)
+
+    kernel = state.params["Dense_0"]["kernel"]  # (16, 128)
+    assert kernel.sharding.spec == PartitionSpec(None, "data")
+    # each device holds 1/8 of the rows -> 1/8 of the bytes (ZeRO-3)
+    shard = kernel.addressable_shards[0].data
+    assert shard.shape == (16, 128 // 8)
+    # adam's moments follow the same placement (ZeRO-1 falls out)
+    mu = state.opt_state[0].mu["Dense_0"]["kernel"]
+    assert mu.sharding.spec == PartitionSpec(None, "data")
+    # small leaves replicate (final bias: 4 elements < min_size)
+    bias = state.params["Dense_1"]["bias"]
+    assert bias.sharding.spec == PartitionSpec()
+
+
+def test_fsdp_numerics_match_data_parallel():
+    """FSDP changes where tensors live, not what the step computes."""
+    mesh = create_mesh({"data": 8})
+    model = MLP(features=(64, 4))
+    ds = make_cls_dataset(n=128, dim=16)
+    x = ds.arrays[0][:32]
+    y = ds.arrays[1][:32]
+
+    def run(strategy):
+        state = create_train_state(
+            model, optax.adam(1e-3), x, strategy=strategy, seed=0
+        )
+        step = make_train_step(loss="cross_entropy")
+        losses = []
+        for _ in range(4):
+            batch = (strategy.shard_batch(x), strategy.shard_batch(y))
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return losses, jax.device_get(state.params)
+
+    losses_dp, params_dp = run(DataParallel(mesh))
+    losses_fs, params_fs = run(FSDP(mesh, min_size=64))
+
+    np.testing.assert_allclose(losses_dp, losses_fs, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        params_dp,
+        params_fs,
+    )
+
+
+def test_trainer_with_fsdp_end_to_end():
+    """The Trainer accepts FSDP as a drop-in strategy and converges."""
+    mesh = create_mesh({"data": 8})
+    loader = ShardedLoader(make_cls_dataset(n=512), 8, mesh)
+    trainer = Trainer(
+        MLP(features=(64, 4)),
+        loader,
+        optax.adam(1e-3),
+        strategy=FSDP(mesh, min_size=64),
+        loss="cross_entropy",
+    )
+    first = trainer._run_epoch(0)
+    last = trainer.train(5)
+    assert last["loss"] < first["loss"] * 0.5
+    # still sharded after training steps (donation preserved placement)
+    k = trainer.state.params["Dense_0"]["kernel"]
+    assert k.sharding.spec == PartitionSpec(None, "data")
+
+
+def test_fsdp_audit_lines():
+    mesh = create_mesh({"data": 8})
+    fsdp = FSDP(mesh, min_size=64)
+    model = MLP(features=(64, 4))
+    params = model.init(jax.random.PRNGKey(0), np.zeros((1, 16), np.float32))[
+        "params"
+    ]
+    lines = fsdp.audit(params)
+    assert any("kernel" in ln and "'data'" in ln for ln in lines)

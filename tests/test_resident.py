@@ -146,3 +146,17 @@ def test_loss_decreases_resident_mnist(mesh):
     first = trainer._run_epoch(0)["loss"]
     last = trainer._run_epoch(1)["loss"]
     assert last < first
+
+
+def test_streaming_iter_applies_transform(mesh):
+    """Iteration-based consumers (Trainer.evaluate) must see the same
+    transformed data the compiled epoch scan trains on."""
+    ds = synthetic_regression(64)
+    resident = DeviceResidentLoader(
+        ds, 8, mesh, shuffle=False,
+        transform=lambda x, y: (x * 2.0, y),
+    )
+    plain = ShardedLoader(ds, 8, mesh, shuffle=False)
+    xb_t, _ = next(iter(resident))
+    xb, _ = next(iter(plain))
+    np.testing.assert_allclose(np.asarray(xb_t), np.asarray(xb) * 2.0, rtol=1e-6)
