@@ -23,8 +23,14 @@ from pytorch_distributed_training_tutorials_tpu.parallel.data_parallel import ( 
     DataParallel,
 )
 from pytorch_distributed_training_tutorials_tpu.parallel.pipeline import (  # noqa: F401
+    GPipe,
     ManualPipeline,
     partition_variables,
+)
+from pytorch_distributed_training_tutorials_tpu.parallel.pipeline_spmd import (  # noqa: F401
+    PipelinedTransformerLM,
+    PipelineParallel,
+    spmd_pipeline,
 )
 from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import (  # noqa: F401
     TensorParallel,

@@ -23,12 +23,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-
-def _keystr(key_path) -> str:
-    """'block/attn/kernel'-style path string from a tree_map_with_path key."""
-    return "/".join(
-        str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
-    )
+from pytorch_distributed_training_tutorials_tpu.utils.tree import keystr as _keystr
 
 
 def save_checkpoint(path: str | os.PathLike, tree) -> None:

@@ -30,6 +30,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from pytorch_distributed_training_tutorials_tpu.parallel.mesh import DATA_AXIS
+from pytorch_distributed_training_tutorials_tpu.utils.tree import keystr
 
 
 def shard_dim_for(shape: tuple[int, ...], world: int, min_size: int) -> int | None:
@@ -122,9 +123,7 @@ class FSDP:
         lines: list[str] = []
 
         def visit(kp, leaf):
-            path = "/".join(
-                str(getattr(k, "key", getattr(k, "idx", k))) for k in kp
-            )
+            path = keystr(kp)
             spec = self.spec_for(tuple(leaf.shape))
             lines.append(f"{path}: {tuple(leaf.shape)} -> {tuple(spec)}")
 

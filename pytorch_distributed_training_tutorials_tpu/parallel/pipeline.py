@@ -30,7 +30,14 @@ from collections.abc import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (
+    DATA_AXIS,
+    STAGE_AXIS,
+)
 
 
 def partition_variables(
@@ -291,3 +298,156 @@ class ManualPipeline:
                 zip(self.stage_param_counts(), self.devices)
             )
         ]
+
+
+def _tree_add(acc, tree):
+    if tree is None:
+        return acc
+    if acc is None:
+        return tree
+    return jax.tree_util.tree_map(jnp.add, acc, tree)
+
+
+def _tree_scale(tree, factor: float):
+    if tree is None:
+        return None
+    return jax.tree_util.tree_map(lambda t: t * factor, tree)
+
+
+class GPipe(ManualPipeline):
+    """Microbatched dp x pp pipeline over a ``{'data': D, 'stage': S}`` mesh,
+    for *heterogeneous* stages (the ResNet cut).
+
+    Where :class:`ManualPipeline` reproduces the reference lesson exactly —
+    one whole device per stage, one batch, stage 0 idle while stage 1 runs
+    (``/root/reference/03.model_parallel.ipynb:830-833``) — ``GPipe`` is the
+    production schedule the lesson motivates, composed with data parallelism:
+
+    - each stage occupies one *column* of the device grid (its own sub-mesh
+      with a ``data`` axis): stage params replicate over the column, and the
+      per-stage gradient allreduce over ``data`` is compiled into each
+      stage's backward by XLA, exactly as in pure DP.
+    - the batch splits into ``num_microbatches`` microbatches that fill and
+      drain the pipeline; stages run concurrently on *different* microbatches
+      (JAX async dispatch schedules the overlap — stage programs live on
+      disjoint devices, so enqueue order is not execution order).
+    - gradients (and BatchNorm statistics) accumulate across microbatches
+      and apply once per step, averaged — numerically the step is plain
+      gradient accumulation, verified against a single-device comparator in
+      ``tests/test_gpipe.py``.
+
+    Heterogeneous stages cannot ride a single ``shard_map`` program (no
+    common stacked-parameter axis to shard over ``stage`` — see
+    :mod:`.pipeline_spmd` for the homogeneous single-program schedule), so
+    each stage is its own XLA program committed to its column; the
+    microbatch hop is an ICI transfer between neighboring columns.
+
+    Build with ``GPipe.from_linen(model, x, devices=mesh,
+    num_microbatches=M, ...)`` — the mesh rides the ``devices`` slot.
+    """
+
+    def __init__(
+        self,
+        stage_fns: Sequence[Callable],
+        stage_vars: Sequence[dict],
+        mesh: Mesh,
+        *,
+        num_microbatches: int,
+        data_axis: str = DATA_AXIS,
+        stage_axis: str = STAGE_AXIS,
+        **kwargs,
+    ):
+        if not isinstance(mesh, Mesh):
+            raise TypeError(
+                "GPipe places stages on a jax.sharding.Mesh with "
+                f"'{data_axis}' and '{stage_axis}' axes; got {type(mesh)}"
+            )
+        if stage_axis not in mesh.shape:
+            raise ValueError(f"mesh has no {stage_axis!r} axis: {mesh.shape}")
+        num_stages = mesh.shape[stage_axis]
+        if num_stages < len(stage_fns):
+            raise ValueError(
+                f"{len(stage_fns)} stages but mesh {stage_axis!r} axis is "
+                f"{num_stages}"
+            )
+        if num_microbatches < 1:
+            raise ValueError("num_microbatches must be >= 1")
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        s_ax = mesh.axis_names.index(stage_axis)
+        rep, act = [], []
+        for s in range(len(stage_fns)):
+            col = np.take(mesh.devices, s, axis=s_ax).reshape(-1)
+            sub = Mesh(col, (data_axis,))
+            rep.append(NamedSharding(sub, PartitionSpec()))
+            act.append(NamedSharding(sub, PartitionSpec(data_axis)))
+        self.act_shardings = act
+        super().__init__(stage_fns, stage_vars, rep, **kwargs)
+
+    @property
+    def dp_size(self) -> int:
+        return self.act_shardings[0].mesh.size
+
+    def forward(self, x) -> jax.Array:
+        """Inference forward: full batch, stage i column -> stage i+1 column
+        (each hop reshards ``data``-split activations to the next column)."""
+        for i in range(self.num_stages):
+            x = jax.device_put(x, self.act_shardings[i])
+            x, _ = self._eval_fwd[i](self.stage_vars[i], x)
+        return x
+
+    def _microbatches(self, arr):
+        m = self.num_microbatches
+        b = arr.shape[0]
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by {m} microbatches")
+        mbs = b // m
+        if mbs % self.dp_size:
+            raise ValueError(
+                f"microbatch {mbs} rows not divisible by dp width "
+                f"{self.dp_size}"
+            )
+        return [arr[i * mbs : (i + 1) * mbs] for i in range(m)]
+
+    def train_step(self, x, y) -> jax.Array:
+        """One optimizer step: GPipe fill (all microbatch forwards), drain
+        (all microbatch backwards), then one averaged update per stage."""
+        if self.tx is None:
+            raise ValueError("construct with optimizer=... to train")
+        n, m = self.num_stages, self.num_microbatches
+        xs, ys = self._microbatches(x), self._microbatches(y)
+
+        stage_inputs = [[None] * m for _ in range(n)]
+        for mb in range(m):
+            a = xs[mb]
+            for i in range(n):
+                a = jax.device_put(a, self.act_shardings[i])
+                stage_inputs[i][mb] = a
+                if i < n - 1:
+                    a, _ = self._fwd[i](self.stage_vars[i], a)
+
+        grad_acc: list = [None] * n
+        upd_acc: list = [None] * n
+        losses = []
+        for mb in range(m):
+            y_mb = jax.device_put(ys[mb], self.act_shardings[-1])
+            loss, grads, ct, upd = self._bwd_last(
+                self.stage_vars[-1], stage_inputs[-1][mb], y_mb
+            )
+            losses.append(loss)
+            grad_acc[-1] = _tree_add(grad_acc[-1], grads)
+            upd_acc[-1] = _tree_add(upd_acc[-1], upd)
+            for i in range(n - 2, -1, -1):
+                ct = jax.device_put(ct, self.act_shardings[i])
+                grads, ct, upd = self._bwd_mid[i](
+                    self.stage_vars[i], stage_inputs[i][mb], ct
+                )
+                grad_acc[i] = _tree_add(grad_acc[i], grads)
+                upd_acc[i] = _tree_add(upd_acc[i], upd)
+
+        inv = 1.0 / m
+        for i in range(n):
+            self._apply_stage(
+                i, _tree_scale(grad_acc[i], inv), _tree_scale(upd_acc[i], inv)
+            )
+        return jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))

@@ -26,13 +26,7 @@ from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
 )
-
-
-def _path_str(key_path) -> str:
-    """'params/block_0/attn/q_proj/kernel'-style path string."""
-    return "/".join(
-        str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
-    )
+from pytorch_distributed_training_tutorials_tpu.utils.tree import keystr as _path_str
 
 
 def _pad_spec(spec: PartitionSpec, ndim: int) -> PartitionSpec:

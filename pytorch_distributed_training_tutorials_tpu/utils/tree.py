@@ -1,0 +1,15 @@
+"""Tiny pytree helpers shared across strategy/checkpoint modules.
+
+Kept dependency-free (no orbax/flax imports) so hot-path modules can use it
+without dragging in heavyweight packages.
+"""
+
+from __future__ import annotations
+
+
+def keystr(key_path) -> str:
+    """'block/attn/kernel'-style path string from a
+    ``jax.tree_util.tree_map_with_path`` key path."""
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
+    )
