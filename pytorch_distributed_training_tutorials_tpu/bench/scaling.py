@@ -1,0 +1,211 @@
+"""DDP allreduce scaling-efficiency sweep: 1 -> N chips.
+
+The BASELINE north-star beyond raw throughput is *scaling*: >=90% ICI
+allreduce efficiency from 1 to 32 chips on the ResNet-18 data-parallel
+workload (``/root/repo/BASELINE.json:5``; the reference's own comparison is
+the 2-GPU-vs-1 wall-clock chart at
+``/root/reference/03.model_parallel.ipynb:1014-1037``). This module is the
+sweep harness: weak scaling (fixed per-device batch), one mesh width at a
+time, slope-timed so async dispatch and host-roundtrip latency cannot lie.
+
+Efficiency definition (weak scaling): with per-device batch ``b`` held
+constant, a D-chip run's ``images/s/chip`` divided by the 1-chip run's.
+Perfect overlap of the gradient allreduce with the backward gives 1.0;
+an exposed allreduce shows up directly as lost efficiency.
+
+Runs unchanged on a CPU mesh (``--xla_force_host_platform_device_count``)
+for CI smoke tests and on a real pod slice for the certified number —
+device widths come from ``jax.devices()`` either way.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+
+import jax
+import numpy as np
+
+from pytorch_distributed_training_tutorials_tpu.bench.harness import slope_time
+
+
+@dataclass
+class ScalePoint:
+    """One mesh width's measurement."""
+
+    num_chips: int
+    per_device_batch: int
+    global_batch: int
+    step_time_s: float
+    images_per_sec: float
+    images_per_sec_per_chip: float
+    efficiency: float  # vs the 1-chip (or narrowest) width
+
+
+def _default_model_and_data(per_device_batch: int, image_px: int):
+    import optax
+
+    from pytorch_distributed_training_tutorials_tpu.models import resnet18
+
+    model = resnet18(num_classes=10, stem="cifar")
+    tx = optax.sgd(1e-2, momentum=0.9)
+
+    def make_batch(global_batch: int):
+        rng = np.random.Generator(np.random.PCG64(0))
+        x = rng.standard_normal(
+            (global_batch, image_px, image_px, 1)
+        ).astype(np.float32)
+        y = rng.integers(0, 10, global_batch).astype(np.int32)
+        return x, y
+
+    return model, tx, make_batch
+
+
+def sweep(
+    widths=None,
+    *,
+    per_device_batch: int = 64,
+    image_px: int = 28,
+    model=None,
+    tx=None,
+    make_batch=None,
+    n1: int = 3,
+    n2: int = 10,
+) -> list[ScalePoint]:
+    """Measure images/s/chip at each data-parallel mesh width.
+
+    ``widths`` defaults to powers of two up to ``len(jax.devices())``.
+    Each width gets its own ``{'data': D}`` mesh over a device prefix, a
+    fresh replicated train state, and a slope-timed run of the jitted
+    train step on a resident batch — the collective cost being measured is
+    the gradient allreduce, exactly DDP's (reference ``ddp_gpus.py:38``).
+    """
+    from pytorch_distributed_training_tutorials_tpu.parallel.data_parallel import (
+        DataParallel,
+    )
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
+        create_train_state,
+        make_train_step,
+    )
+
+    devices = jax.devices()
+    if not widths:  # None or [] (a bare --widths flag): powers of 2
+        widths = []
+        w = 1
+        while w <= len(devices):
+            widths.append(w)
+            w *= 2
+    widths = sorted(set(widths))
+    if widths[-1] > len(devices):
+        raise ValueError(
+            f"width {widths[-1]} exceeds {len(devices)} available devices"
+        )
+
+    if model is None:
+        model, tx, make_batch = _default_model_and_data(
+            per_device_batch, image_px
+        )
+    elif tx is None or make_batch is None:
+        raise ValueError(
+            "sweep(model=...) requires tx and make_batch as well"
+        )
+
+    points: list[ScalePoint] = []
+    base_per_chip: float | None = None
+    for width in widths:
+        mesh = create_mesh({"data": width}, devices=devices[:width])
+        dp = DataParallel(mesh)
+        global_batch = per_device_batch * width
+        x, y = make_batch(global_batch)
+        batch = (dp.shard_batch(x), dp.shard_batch(y))
+        state = create_train_state(model, tx, x, strategy=dp)
+        has_bn = state.batch_stats is not None
+        step = make_train_step(loss="cross_entropy", has_batch_stats=has_bn)
+
+        # state is donated: thread it through the chained steps
+        state_box = [state]
+
+        def run(k):
+            s = state_box[0]
+            for _ in range(k):
+                s, metrics = step(s, batch)
+            state_box[0] = s
+            return float(metrics["loss"])
+
+        dt = slope_time(run, n1=n1, n2=n2, warmup=2)
+        per_chip = global_batch / dt / width
+        if base_per_chip is None:
+            base_per_chip = per_chip
+        points.append(
+            ScalePoint(
+                num_chips=width,
+                per_device_batch=per_device_batch,
+                global_batch=global_batch,
+                step_time_s=dt,
+                images_per_sec=global_batch / dt,
+                images_per_sec_per_chip=per_chip,
+                efficiency=per_chip / base_per_chip,
+            )
+        )
+    return points
+
+
+def report(points: list[ScalePoint], *, workload: str | None = None) -> dict:
+    """JSON-ready sweep summary (the shape committed as scaling JSON)."""
+    return {
+        "metric": "ddp_weak_scaling_efficiency",
+        "workload": workload
+        or "resnet18 synthetic images, cross-entropy, sgd+momentum",
+        "backend": jax.default_backend(),
+        "points": [asdict(p) for p in points],
+        "efficiency_at_max_width": points[-1].efficiency if points else None,
+    }
+
+
+def main() -> None:
+    import argparse
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # this build's sitecustomize pre-imports jax._src, so the env var
+        # alone can be captured too late — forward it via the config API
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--widths", type=int, nargs="*", default=None,
+        help="mesh widths to sweep (default: powers of 2 up to all devices)",
+    )
+    parser.add_argument("--per_device_batch", type=int, default=64)
+    parser.add_argument("--image_px", type=int, default=28)
+    parser.add_argument("--out", type=str, default=None, help="JSON path")
+    args = parser.parse_args()
+
+    points = sweep(
+        args.widths,
+        per_device_batch=args.per_device_batch,
+        image_px=args.image_px,
+    )
+    rep = report(
+        points,
+        workload=(
+            f"resnet18 synthetic {args.image_px}x{args.image_px}, "
+            "cross-entropy, sgd+momentum"
+        ),
+    )
+    for p in points:
+        print(
+            f"  {p.num_chips:>3} chips: {p.images_per_sec_per_chip:,.0f} "
+            f"img/s/chip, efficiency {p.efficiency:.3f}"
+        )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rep, f, indent=1)
+        print(f"wrote {args.out}")
+    else:
+        print(json.dumps(rep))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,12 +73,71 @@ def load_sharded(
         return ckptr.restore(path, abstract)
 
 
+def checkpoint_leaf_metadata(path: str | os.PathLike):
+    """Flat ``(key_path, array_metadata)`` list + treedef for a checkpoint."""
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        meta = ckptr.metadata(path)
+        tree = meta.item_metadata if hasattr(meta, "item_metadata") else meta
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return flat, treedef
+
+
+def restore_leaf(
+    path: str | os.PathLike,
+    key_path: tuple,
+    meta,
+    sharding: jax.sharding.Sharding | None = None,
+    checkpointer: ocp.Checkpointer | None = None,
+):
+    """Restore exactly one leaf from a checkpoint (no other IO happens —
+    the other leaves are never read, so host peak is this leaf's size).
+
+    With ``sharding``, the leaf deserializes *straight into device memory*
+    with that placement; otherwise it lands as host numpy. Pass an open
+    ``checkpointer`` when restoring many leaves in a loop (one handler,
+    not one per leaf).
+    """
+    keys = tuple(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
+    )
+    sds = jax.ShapeDtypeStruct(meta.shape, meta.dtype)
+    item: object = sds
+    restore_arg: object = (
+        ocp.ArrayRestoreArgs(sharding=sharding)
+        if sharding is not None
+        else ocp.RestoreArgs(restore_type=np.ndarray)
+    )
+    for k in reversed(keys):
+        item = {k: item}
+        restore_arg = {k: restore_arg}
+
+    def _restore(ckptr):
+        return ckptr.restore(
+            os.path.abspath(path),
+            args=ocp.args.PyTreeRestore(
+                item=item, transforms={}, restore_args=restore_arg
+            ),
+        )
+
+    if checkpointer is not None:
+        out = _restore(checkpointer)
+    else:
+        with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+            out = _restore(ckptr)
+    for k in keys:
+        out = out[k]
+    return out
+
+
 def load_quantized(
     path: str | os.PathLike,
     should_quantize: Callable[[str, np.ndarray], bool] | None = None,
     channel_axis: int = -1,
+    sharding_fn: Callable | None = None,
 ):
-    """Restore a checkpoint with selected weights quantized to int8 on load.
+    """Restore a checkpoint with selected weights quantized to int8 on load,
+    **streaming one leaf at a time**.
 
     The ``load_in_8bit=True`` twin (reference ``03.model_parallel.ipynb``
     cell 2, SURVEY.md C13): matmul weights come back as
@@ -86,15 +145,21 @@ def load_quantized(
     scales, 1/4 the HBM) while norms/biases/embeddings stay float — the same
     mixed-precision layout the tutorial's param audit shows (cell 4).
 
+    Each leaf is restored individually (:func:`restore_leaf`), quantized,
+    and only then is the next leaf read — the float checkpoint is **never
+    materialized in full**: peak host usage is the largest single leaf plus
+    the (4x smaller) accumulated int8 tree, the same bound the reference
+    gets from streaming its 33 shards through bitsandbytes one at a time.
+    Verified by the RSS test in ``tests/test_auto.py``.
+
     ``should_quantize(path_str, leaf) -> bool`` selects the weights; the
     default quantizes every rank->=2 leaf whose path ends in ``kernel``.
+    ``sharding_fn(key_path, meta) -> Sharding`` additionally places each
+    restored leaf straight onto devices (quantization then runs on-device),
+    composing 8-bit load with mesh-sharded auto placement — the full
+    ``device_map="auto" + load_in_8bit`` combination.
     Serve the result with :class:`..ops.quant.Int8Dense`-style modules or
     by calling ``.dequantize()`` at use sites.
-
-    Memory note: the float checkpoint is restored to *host* RAM in full
-    before quantization (devices only ever see the int8 tree), so peak host
-    usage is the f32 checkpoint size. A streaming per-leaf restore that
-    bounds host peak at the largest single leaf is future work.
     """
     from pytorch_distributed_training_tutorials_tpu.ops.quant import quantize_int8
 
@@ -102,14 +167,21 @@ def load_quantized(
         def should_quantize(p, leaf):  # noqa: F811
             return p.endswith("kernel") and getattr(leaf, "ndim", 0) >= 2
 
-    tree = restore_checkpoint(path)
-
-    def visit(kp, leaf):
-        if should_quantize(_keystr(kp), leaf):
-            return quantize_int8(leaf, channel_axis=channel_axis)
-        return leaf
-
-    return jax.tree_util.tree_map_with_path(visit, tree)
+    path = os.path.abspath(path)
+    out_flat = []
+    flat_meta, treedef = checkpoint_leaf_metadata(path)
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        for kp, m in flat_meta:
+            sharding = sharding_fn(tuple(kp), m) if sharding_fn else None
+            leaf = restore_leaf(
+                path, kp, m, sharding=sharding, checkpointer=ckptr
+            )
+            if should_quantize(_keystr(kp), leaf):
+                q = quantize_int8(leaf, channel_axis=channel_axis)
+                del leaf  # free the f32 before the next leaf is read
+                leaf = q
+            out_flat.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out_flat)
 
 
 def audit_placement(tree) -> list[str]:

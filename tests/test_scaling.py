@@ -1,0 +1,53 @@
+"""Scaling-efficiency sweep harness (BASELINE.json north-star tooling)."""
+
+import numpy as np
+import optax
+
+from pytorch_distributed_training_tutorials_tpu.bench.scaling import report, sweep
+from pytorch_distributed_training_tutorials_tpu.models import MLP
+
+
+def _tiny_workload(per_device_batch=8):
+    model = MLP(features=(32, 4))
+    tx = optax.sgd(1e-2)
+
+    def make_batch(global_batch):
+        rng = np.random.Generator(np.random.PCG64(0))
+        x = rng.standard_normal((global_batch, 16)).astype(np.float32)
+        y = rng.integers(0, 4, global_batch).astype(np.int32)
+        return x, y
+
+    return model, tx, make_batch
+
+
+def test_sweep_structure(devices):
+    model, tx, make_batch = _tiny_workload()
+    points = sweep(
+        [1, 2, 4],
+        per_device_batch=8,
+        model=model,
+        tx=tx,
+        make_batch=make_batch,
+        n1=2,
+        n2=4,
+    )
+    assert [p.num_chips for p in points] == [1, 2, 4]
+    for p in points:
+        assert p.global_batch == 8 * p.num_chips  # weak scaling
+        assert p.step_time_s > 0
+        assert np.isclose(
+            p.images_per_sec_per_chip, p.images_per_sec / p.num_chips
+        )
+    assert points[0].efficiency == 1.0  # self-referenced baseline
+    rep = report(points)
+    assert rep["metric"] == "ddp_weak_scaling_efficiency"
+    assert len(rep["points"]) == 3
+    assert rep["efficiency_at_max_width"] == points[-1].efficiency
+
+
+def test_sweep_rejects_oversubscription(devices):
+    model, tx, make_batch = _tiny_workload()
+    import pytest
+
+    with pytest.raises(ValueError, match="exceeds"):
+        sweep([16], model=model, tx=tx, make_batch=make_batch)
