@@ -103,6 +103,38 @@ class ShardedLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
+    def sample_batch(self):
+        """A representative (host) sample for model init — the loader-owned
+        seam that keeps consumers (Trainer) out of the dataset's internals.
+        Returns full-length views (numpy slices are views, not copies), so
+        init-time consumers can slice whatever row count their mesh needs."""
+        arrays = self.dataset.arrays
+        sample = tuple(a[:] for a in arrays)
+        return sample if len(arrays) > 1 else sample[0]
+
+    def valid_mask(self, step: int) -> np.ndarray:
+        """(global_batch,) bool mask, replica-major like the batch rows:
+        True for real samples, False for wrap-padding duplicates.
+
+        The reference's DistributedSampler *counts* its padded duplicates in
+        every metric (it has no way to tell them apart downstream); here the
+        loader computes the pad exactly — a slot is padding iff its position
+        in the flat enumeration falls beyond the dataset, either in the
+        sampler's wrap to equal shards or in the loader's wrap to a whole
+        number of steps. Used by ``Trainer.evaluate`` for unbiased eval.
+        """
+        n = len(self.dataset)
+        num_samples = self._sampler.num_samples
+        lo = step * self.per_device_batch
+        cols = np.arange(lo, lo + self.per_device_batch)
+        ranks = np.arange(self.world)[:, None]  # (world, 1)
+        # shards[r, c] = flat[c * world + r]; tiled columns (c >= num_samples)
+        # and flat positions past the dataset are padding
+        real = (cols[None, :] < num_samples) & (
+            cols[None, :] * self.world + ranks < n
+        )
+        return real.reshape(-1)  # replica-major, matches __iter__ row order
+
     def _epoch_index_matrix(self) -> np.ndarray:
         """(world, steps * per_device_batch) index matrix for this epoch."""
         flat = self._sampler._global_indices()  # (num_samples * world,)

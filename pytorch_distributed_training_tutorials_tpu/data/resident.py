@@ -73,17 +73,24 @@ class DeviceResidentLoader(ShardedLoader):
             jax.device_put(a, rep) for a in dataset.arrays
         )
 
+    def _apply_transform(self, batch):
+        if self.transform is None:
+            return batch
+        if isinstance(batch, tuple):
+            return self.transform(*batch)
+        return self.transform(batch)
+
+    def sample_batch(self):
+        """Parent's host sample with ``transform`` applied — model init must
+        see the shapes/dtypes the compiled epoch actually trains on."""
+        return self._apply_transform(super().sample_batch())
+
     def __iter__(self):
         """Streaming iteration (parent semantics) with ``transform`` applied,
         so iteration-based consumers (``Trainer.evaluate``, plain loops) see
         the same data the compiled epoch scan trains on."""
         for batch in super().__iter__():
-            if self.transform is None:
-                yield batch
-            elif isinstance(batch, tuple):
-                yield self.transform(*batch)
-            else:
-                yield self.transform(batch)
+            yield self._apply_transform(batch)
 
     def epoch_index_array(self, epoch: int) -> jax.Array:
         """The epoch's ``(steps, global_batch)`` int32 index matrix, on

@@ -217,13 +217,18 @@ def make_epoch_scan(
 
 
 def make_eval_step(loss: str = "cross_entropy", has_batch_stats: bool = False):
-    """Jitted eval step: per-batch (summed per-sample loss, correct count).
+    """Jitted eval step: per-batch (summed per-sample loss, correct count,
+    sample count), weighted by a per-row validity ``mask``.
 
-    ``correct`` is an argmax-accuracy count for integer-label cross-entropy
-    and 0 otherwise (regression has no accuracy).
+    ``mask`` (shape ``(B,)``) zeroes out wrap-padded duplicate rows (the
+    equal-shard padding the reference's DistributedSampler silently counts —
+    the framework computes the pad, so eval can mask it;
+    :meth:`..data.loader.ShardedLoader.valid_mask`). ``correct`` is an
+    argmax-accuracy count for integer-label cross-entropy and 0 otherwise
+    (regression has no accuracy).
     """
 
-    def eval_fn(state: TrainState, batch):
+    def eval_fn(state: TrainState, batch, mask):
         x, y = batch
         variables = {"params": state.params}
         if has_batch_stats:
@@ -231,19 +236,37 @@ def make_eval_step(loss: str = "cross_entropy", has_batch_stats: bool = False):
             logits = state.apply_fn(variables, x, train=False)
         else:
             logits = state.apply_fn(variables, x)
+        mask = mask.astype(jnp.float32)
         classification = loss == "cross_entropy" and y.ndim < logits.ndim
         if classification:
-            # per-label stats (for an LM, labels = every token position)
-            loss_sum = optax.softmax_cross_entropy_with_integer_labels(
+            # per-label stats (for an LM, labels = every token position);
+            # the row mask broadcasts over the label positions
+            mask_rows = mask.reshape(mask.shape[0], *([1] * (y.ndim - 1)))
+            per_label = optax.softmax_cross_entropy_with_integer_labels(
                 logits, y
-            ).sum()
-            correct = jnp.sum(jnp.argmax(logits, -1) == y)
-            count = y.size
+            )
+            loss_sum = (per_label * mask_rows).sum()
+            correct = jnp.sum(
+                (jnp.argmax(logits, -1) == y) * mask_rows
+            ).astype(jnp.int32)
+            count = (jnp.ones_like(y, jnp.float32) * mask_rows).sum()
         else:
-            # batch-mean loss scaled back to a sum; accuracy undefined
-            loss_sum = _compute_loss(loss, logits, y) * y.shape[0]
+            # per-sample loss over feature dims; accuracy undefined
+            if loss == "mse":
+                feat_axes = tuple(range(1, y.ndim))
+                per_sample = jnp.mean(
+                    (logits - y) ** 2, axis=feat_axes
+                ) if feat_axes else (logits - y) ** 2
+            else:  # soft-target cross entropy: (B, ...) per-position losses
+                per_sample = optax.softmax_cross_entropy(logits, y)
+            # broadcast the row mask over any remaining positions (e.g. an
+            # LM's (B, T) soft-target losses)
+            mask_rows = mask.reshape(
+                mask.shape[0], *([1] * (per_sample.ndim - 1))
+            )
+            loss_sum = (per_sample * mask_rows).sum()
             correct = jnp.zeros((), jnp.int32)
-            count = y.shape[0]
+            count = (jnp.ones_like(per_sample) * mask_rows).sum()
         return loss_sum, correct, count
 
     return jax.jit(eval_fn)
@@ -275,7 +298,11 @@ class Trainer:
         self.strategy = strategy if strategy is not None else DataParallel(
             train_loader.mesh
         )
-        sample = train_loader.dataset.arrays[0]  # create_train_state slices
+        # the loader-owned seam (no reaching into dataset internals): any
+        # loader exposing sample_batch() works — streaming, resident, custom
+        sample = train_loader.sample_batch()
+        if isinstance(sample, tuple):
+            sample = sample[0]
         self.state = create_train_state(
             model, optimizer, sample, strategy=self.strategy, seed=seed
         )
@@ -429,27 +456,58 @@ class Trainer:
     def evaluate(self, eval_loader=None) -> dict:
         """Mean loss (the trainer's configured loss) + accuracy (for
         integer-label classification; 0.0 otherwise) over ``eval_loader``
-        (default: the training loader). Wrap-padded duplicate rows
-        (equal-shard padding) are counted like the reference's
-        DistributedSampler would."""
+        (default: the training loader).
+
+        Wrap-padded duplicate rows (the equal-shard padding SPMD requires)
+        are **masked out** when the loader can identify them
+        (:meth:`..data.loader.ShardedLoader.valid_mask`), so metrics are
+        unbiased on datasets that don't divide evenly — unlike the
+        reference, whose DistributedSampler silently double-counts the pad.
+        """
+        import numpy as np
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
         loader = eval_loader if eval_loader is not None else self.loader
         if self._eval_step is None:
             self._eval_step = make_eval_step(
                 self.loss_name, self.has_batch_stats
             )
+        has_mask = hasattr(loader, "valid_mask")
+        mask_sharding = (
+            NamedSharding(loader.mesh, PartitionSpec(loader.axis))
+            if has_mask
+            else None
+        )
         # accumulate device arrays; convert once after the loop so eval
         # dispatch stays async (a float() per batch would sync every step)
         losses, corrects, counts = [], [], []
-        for batch in loader:
+        mask_cache: dict = {}  # padding lives in the tail steps; interior
+        # steps share one all-ones mask — transfer each distinct mask once
+
+        def device_mask(step, rows):
+            if not has_mask:
+                key = b"ones"
+                if key not in mask_cache:
+                    mask_cache[key] = jnp.ones((rows,), jnp.float32)
+                return mask_cache[key]
+            m = loader.valid_mask(step).astype(np.float32)
+            key = m.tobytes()
+            if key not in mask_cache:
+                mask_cache[key] = jax.device_put(m, mask_sharding)
+            return mask_cache[key]
+
+        for step, batch in enumerate(loader):
             if not isinstance(batch, tuple) or len(batch) != 2:
                 raise ValueError("evaluate() requires (x, y) batches")
-            ls, c, n = self._eval_step(self.state, batch)
+            mask = device_mask(step, batch[0].shape[0])
+            ls, c, n = self._eval_step(self.state, batch, mask)
             losses.append(ls)
             corrects.append(c)
             counts.append(n)
         loss_sum = float(sum(float(l) for l in jax.device_get(losses)))
         correct = int(sum(int(c) for c in jax.device_get(corrects)))
-        seen = int(sum(int(n) for n in jax.device_get(counts)))
+        seen = int(sum(float(n) for n in jax.device_get(counts)))
         return {
             "loss": loss_sum / max(seen, 1),
             "accuracy": correct / max(seen, 1),

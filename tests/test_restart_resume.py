@@ -1,0 +1,130 @@
+"""Restart-and-resume: the torchrun elastic-agent behavior, closed end-to-end.
+
+The reference's torchrun script is restart-safe only by being *stateless* —
+a worker death means the elastic agent re-execs the world and training starts
+over (``/root/reference/ddp_gpus_torchrun.py:12-14``; SURVEY.md section 5.3).
+This framework does strictly better: ``spawn(..., max_restarts=N)`` re-forks
+a failed world AND the Trainer resumes from its latest checkpoint, so the
+final model equals an uninterrupted run's — proven here by killing a worker
+mid-train with a real ``os._exit`` and comparing final losses.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.launch import spawn
+
+NPROCS = 2
+EPOCHS = 4
+
+
+def _resumable_worker(rank: int, workdir: str, fail_at_epoch: int) -> None:
+    """Trains EPOCHS epochs with per-epoch checkpointing; restores from the
+    latest checkpoint at start. On the FIRST attempt only (sentinel file),
+    rank 1 dies hard (os._exit, no cleanup — a real worker crash) after the
+    checkpoint at ``fail_at_epoch`` is written."""
+    from pytorch_distributed_training_tutorials_tpu.parallel import distributed
+
+    distributed.init()  # env contract: topology from spawn-injected env
+    import jax
+    import optax
+
+    from pytorch_distributed_training_tutorials_tpu.data import ShardedLoader
+    from pytorch_distributed_training_tutorials_tpu.data.datasets import ArrayDataset
+    from pytorch_distributed_training_tutorials_tpu.models import LinearRegressor
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+    from pytorch_distributed_training_tutorials_tpu.train import Trainer
+
+    # learnable regression, deterministic across attempts
+    rng = np.random.Generator(np.random.PCG64(0))
+    x = rng.standard_normal((256, 8)).astype(np.float32)
+    w = rng.standard_normal((8, 1)).astype(np.float32)
+    y = x @ w + 0.01 * rng.standard_normal((256, 1)).astype(np.float32)
+
+    mesh = create_mesh()
+    loader = ShardedLoader(ArrayDataset((x, y)), 32, mesh, shuffle=True)
+    trainer = Trainer(LinearRegressor(in_dim=8), loader, optax.sgd(0.05), loss="mse")
+
+    ckpt = os.path.join(workdir, "ckpt")
+    sentinel = os.path.join(workdir, "crashed_once")
+    if os.path.exists(ckpt):
+        trainer.restore(ckpt)  # restart-and-RESUME, not restart-from-scratch
+        assert trainer.epoch > 0  # the crash left completed epochs behind
+
+    while trainer.epoch < EPOCHS:
+        metrics = trainer.train(trainer.epoch + 1)  # one epoch
+        trainer.save(ckpt)
+        if (
+            fail_at_epoch >= 0
+            and trainer.epoch == fail_at_epoch
+            and rank == 1
+            and not os.path.exists(sentinel)
+        ):
+            with open(sentinel, "w") as f:
+                f.write("1")
+            os._exit(17)  # hard crash: no teardown, peers left hanging
+
+    if rank == 0:
+        with open(os.path.join(workdir, "result.json"), "w") as f:
+            json.dump({"loss": metrics["loss"], "epoch": trainer.epoch}, f)
+    distributed.shutdown()
+
+
+def _final_loss(workdir) -> dict:
+    with open(os.path.join(workdir, "result.json")) as f:
+        return json.load(f)
+
+
+def test_restart_resumes_from_checkpoint_and_matches_uninterrupted(tmp_path):
+    crash_dir = str(tmp_path / "crashy")
+    clean_dir = str(tmp_path / "clean")
+    os.makedirs(crash_dir)
+    os.makedirs(clean_dir)
+
+    # interrupted world: rank 1 dies after epoch 2's checkpoint; the gang is
+    # torn down, re-forked, and resumes at epoch 2
+    spawn(
+        _resumable_worker,
+        NPROCS,
+        args=(crash_dir, 2),
+        env_contract=True,
+        platform="cpu",
+        max_restarts=1,
+        join_timeout_s=600,
+    )
+    assert os.path.exists(os.path.join(crash_dir, "crashed_once"))
+
+    # uninterrupted control world
+    spawn(
+        _resumable_worker,
+        NPROCS,
+        args=(clean_dir, -1),
+        env_contract=True,
+        platform="cpu",
+        max_restarts=0,
+        join_timeout_s=600,
+    )
+
+    crashed = _final_loss(crash_dir)
+    clean = _final_loss(clean_dir)
+    assert crashed["epoch"] == clean["epoch"] == EPOCHS
+    # bitwise-identical resume (test_checkpoint_resume) => identical final loss
+    np.testing.assert_allclose(crashed["loss"], clean["loss"], rtol=1e-6)
+
+
+def test_exhausted_restarts_raise(tmp_path):
+    with pytest.raises(RuntimeError, match="workers failed"):
+        spawn(
+            _always_dying_worker,
+            1,
+            platform="cpu",
+            max_restarts=2,
+            join_timeout_s=120,
+        )
+
+
+def _always_dying_worker(rank: int) -> None:
+    raise SystemExit(5)

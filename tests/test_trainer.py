@@ -119,3 +119,52 @@ def test_resnet_train_step_with_batch_stats():
     m = trainer._run_epoch(0)
     assert np.isfinite(m["loss"])
     assert int(trainer.state.step) == 2  # 64 / 4 / 8
+
+
+def test_evaluate_masks_wrap_padding():
+    """Unbiased eval on a dataset that doesn't divide evenly: 100 samples on
+    8 devices x bs 4 pads to 104 slots; masked eval must equal the plain
+    single-device metrics over exactly the 100 unique samples (the
+    reference's DistributedSampler would double-count the 4 duplicates)."""
+    import optax
+    from helpers import make_cls_dataset
+
+    ds = make_cls_dataset(n=100, dim=16, classes=4)
+    mesh = create_mesh({"data": 8})
+    loader = ShardedLoader(ds, 4, mesh, shuffle=False)
+    trainer = Trainer(
+        MLP(features=(32, 4)), loader, optax.adam(1e-3), loss="cross_entropy"
+    )
+    m = trainer.evaluate()
+    assert m["samples"] == 100  # not 104
+
+    # single-device ground truth over the unique samples
+    logits = trainer.state.apply_fn(
+        {"params": jax.device_get(trainer.state.params)}, ds.arrays[0]
+    )
+    import optax as _optax
+
+    ref_loss = float(
+        _optax.softmax_cross_entropy_with_integer_labels(
+            jnp.asarray(logits), jnp.asarray(ds.arrays[1])
+        ).mean()
+    )
+    ref_acc = float(
+        (np.argmax(np.asarray(logits), -1) == ds.arrays[1]).mean()
+    )
+    np.testing.assert_allclose(m["loss"], ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(m["accuracy"], ref_acc, rtol=1e-6)
+
+
+def test_valid_mask_counts():
+    """valid_mask marks exactly dataset-size slots real across the epoch."""
+    from pytorch_distributed_training_tutorials_tpu.data.datasets import ArrayDataset
+
+    ds = ArrayDataset((np.zeros((100, 4), np.float32),))
+    mesh = create_mesh({"data": 8})
+    loader = ShardedLoader(ds, 4, mesh, shuffle=True)
+    total_real = sum(
+        int(loader.valid_mask(s).sum()) for s in range(len(loader))
+    )
+    assert total_real == 100
+    assert loader.valid_mask(0).shape == (32,)  # global batch, replica-major
