@@ -9,13 +9,17 @@ run, so later rounds measure improvement against round 1.
 The headline number is the **end-to-end training loop** including the input
 pipeline — not a cached batch replayed. The input pipeline is the
 device-resident one (``data/resident.py``): the dataset is placed in HBM
-once, and each epoch is a single jitted ``lax.scan`` whose body gathers the
-step's batch on device (the TPU-idiomatic shape for datasets far smaller
-than HBM; on the tunneled runtime it is also ~3x faster end-to-end than
-per-step dispatch). The JSON line carries the honesty metadata: whether the
-data was a synthetic surrogate (no network egress in the build env) and a
-breakdown (streaming input pipeline alone, train step alone) so a host-side
-bottleneck is visible rather than hidden.
+once, and the measured region is a multi-epoch ``lax.scan`` whose body
+gathers each step's batch on device — one XLA launch and one host fetch for
+the whole region (a device-trace profile showed per-epoch launch/fetch
+costing ~8% on the tunneled runtime; the remaining step time is dominated by
+BatchNorm statistics/elementwise fusions, not convolutions — see the round-2
+commit message for the trace analysis). The JSON line carries the honesty
+metadata: whether the data was a synthetic surrogate (no network egress in
+the build env), a breakdown (streaming input pipeline alone, train step
+alone), and the held-out eval accuracy against the stated 0.99 target (the
+BASELINE "reaches reference accuracy" demonstration, measured unbiased —
+wrap-padding masked).
 
 Prints exactly one JSON line on stdout
 (``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``);
@@ -37,10 +41,11 @@ BASELINE_IMAGES_PER_SEC_PER_CHIP = 46400.0
 
 def main() -> None:
     import jax
+    import time
+
     import jax.numpy as jnp
     import optax
 
-    from pytorch_distributed_training_tutorials_tpu.bench.harness import slope_time
     from pytorch_distributed_training_tutorials_tpu.data import (
         DeviceResidentLoader,
         ShardedLoader,
@@ -48,36 +53,48 @@ def main() -> None:
     )
     from pytorch_distributed_training_tutorials_tpu.models import resnet18
     from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
-    from pytorch_distributed_training_tutorials_tpu.train import (
-        Trainer,
-        make_train_step,
+    from pytorch_distributed_training_tutorials_tpu.train import Trainer
+    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
+        _train_step_fn,
     )
 
     mesh = create_mesh()
     n_chips = mesh.devices.size
-    per_device_batch = 256
+    per_device_batch = 512
 
-    ds = mnist("train")
-    loader = DeviceResidentLoader(ds, per_device_batch, mesh, seed=0)
+    # uint8 at rest in HBM (the on-disk dtype, 1/4 the f32 bytes, ~4x less
+    # per-step gather traffic); the /255 normalize runs inside the compiled
+    # step and fuses into the stem convolution
+    ds = mnist("train", raw=True)
+    loader = DeviceResidentLoader(
+        ds,
+        per_device_batch,
+        mesh,
+        seed=0,
+        transform=lambda x, y: (x.astype(jnp.bfloat16) / 255.0, y),
+    )
     model = resnet18(num_classes=10, stem="cifar", dtype=jnp.bfloat16)
     trainer = Trainer(
         model, loader, optax.sgd(0.05, momentum=0.9), loss="cross_entropy"
     )
 
+    fused_epochs = 3
     with contextlib.redirect_stdout(sys.stderr):
-        # Epoch 0 compiles and warms every cache; epochs 1-2 are the honest
-        # end-to-end measurement (dataset residency + on-device gather +
-        # train step, synced by the host fetch of the final loss).
+        # Epoch 0 compiles the per-epoch program; the first fused call
+        # compiles the fused-run program (different scan length); the second
+        # fused call is the honest end-to-end measurement: dataset residency,
+        # on-device gather, train step, ONE launch + ONE host fetch for the
+        # whole region (profile finding: per-epoch launch/fetch overhead was
+        # ~8% of epoch wall time on the tunneled runtime).
         trainer._run_epoch(0)
-        e2e = max(
-            trainer._run_epoch(epoch)["samples_per_sec"] for epoch in (1, 2)
-        )
+        trainer.run_epochs_fused(1, fused_epochs)  # compile warmup
+        e2e = trainer.run_epochs_fused(1 + fused_epochs, fused_epochs)[
+            "samples_per_sec"
+        ]
 
         # Breakdown leg 1: the *streaming* input pipeline (native C++ row
         # gather + per-batch H2D), one full pass, no compute — what a
         # larger-than-HBM dataset would pay on the host side.
-        import time
-
         streaming = ShardedLoader(ds, per_device_batch, mesh, seed=0)
         t0 = time.perf_counter()
         n_batches = 0
@@ -88,22 +105,51 @@ def main() -> None:
             time.perf_counter() - t0
         )
 
-        # Breakdown leg 2: train step alone on a cached batch (the round-1
-        # measurement) — the device-side ceiling for per-step dispatch.
-        batch = next(iter(streaming))
-        step = make_train_step(loss="cross_entropy", has_batch_stats=True)
-        state = trainer.state
-
-        def run(k: int) -> None:
-            nonlocal state
-            last = None
-            for _ in range(k):
-                state, last = step(state, batch)
-            float(last["loss"])
-
-        step_images_s = streaming.global_batch / slope_time(
-            run, n1=5, n2=25, warmup=3
+        # Breakdown leg 2: train step alone on a cached batch — a jitted
+        # scan of N chained steps, timed as one launch + one fetch. (Round 1
+        # slope-timed individual dispatches, which over-reported ~60% on the
+        # tunneled runtime vs the XLA device trace; the scanned chain matches
+        # the trace's per-step time.)
+        # normalized once outside the chain: this leg isolates the train
+        # step itself (the e2e path fuses the equivalent transform in-scan)
+        batch = jax.block_until_ready(
+            loader.transform(*next(iter(streaming)))
         )
+        step_fn = _train_step_fn("cross_entropy", has_batch_stats=True)
+        chain_len = 256
+
+        @jax.jit
+        def chain(state):
+            def body(s, _):
+                s, m = step_fn(s, batch)
+                return s, m["loss"]
+
+            return jax.lax.scan(body, state, None, length=chain_len)
+
+        state = trainer.state
+        state, losses = chain(state)  # compile
+        jax.block_until_ready(losses)
+        t0 = time.perf_counter()
+        state, losses = chain(state)
+        float(losses[-1])
+        step_images_s = (
+            chain_len * streaming.global_batch / (time.perf_counter() - t0)
+        )
+
+        # Accuracy demonstration (BASELINE north star: "reaches reference
+        # accuracy"): evaluate on the held-out test split with wrap-padding
+        # masked (unbiased). Target: 0.99 — conventional MNIST ResNet
+        # accuracy; the synthetic surrogate is easier, so missing the target
+        # on ANY data flags a training regression (the `synthetic` field
+        # says which data this run used).
+        test_loader = DeviceResidentLoader(
+            mnist("test", raw=True),
+            per_device_batch,
+            mesh,
+            seed=0,
+            transform=loader.transform,
+        )
+        eval_metrics = trainer.evaluate(test_loader)
 
     per_chip = e2e / n_chips
     print(
@@ -121,6 +167,12 @@ def main() -> None:
                 "synthetic": bool(ds.synthetic),
                 "n_chips": n_chips,
                 "per_device_batch": per_device_batch,
+                "eval_accuracy": round(eval_metrics["accuracy"], 4),
+                "eval_loss": round(eval_metrics["loss"], 6),
+                "accuracy_target": 0.99,
+                "reaches_accuracy_target": bool(
+                    eval_metrics["accuracy"] >= 0.99
+                ),
                 "breakdown": {
                     "input_pipeline_images_per_sec_per_chip": round(
                         input_images_s / n_chips, 1

@@ -115,6 +115,7 @@ def _synthetic_images(
     num_classes: int,
     template_seed: int,
     noise_seed: int,
+    raw: bool = False,
 ) -> ArrayDataset:
     """Deterministic class-separable surrogate for an image dataset.
 
@@ -124,6 +125,12 @@ def _synthetic_images(
     are seeded separately from the noise so train/test splits share one
     underlying distribution (same classes, fresh samples) — otherwise
     evaluation on the test split would be noise.
+
+    Like the real datasets, the surrogate is **uint8 at rest** (quantized to
+    ~N(128, 32) pixel values): ``raw=True`` returns the uint8 bytes (for
+    device-resident pipelines that normalize on device — 4x less HBM gather
+    traffic), ``raw=False`` the float32 ``uint8 / 255`` view, so the two
+    modes see byte-identical data.
     """
     t_rng = np.random.Generator(np.random.PCG64(template_seed))
     templates = t_rng.standard_normal((num_classes, *shape)).astype(np.float32)
@@ -132,7 +139,12 @@ def _synthetic_images(
     images = templates[labels] * 0.5 + 0.5 * rng.standard_normal(
         (n, *shape)
     ).astype(np.float32)
-    return ArrayDataset((images, labels), synthetic=True)
+    u8 = np.clip(images * 64.0 + 128.0, 0, 255).astype(np.uint8)
+    if raw:
+        return ArrayDataset((u8, labels), synthetic=True)
+    return ArrayDataset(
+        (u8.astype(np.float32) / 255.0, labels), synthetic=True
+    )
 
 
 def _read_idx(path: str) -> np.ndarray:
@@ -144,12 +156,21 @@ def _read_idx(path: str) -> np.ndarray:
         return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
 
 
-def mnist(split: str = "train", data_dir: str | None = None) -> ArrayDataset:
+def mnist(
+    split: str = "train",
+    data_dir: str | None = None,
+    *,
+    raw: bool = False,
+) -> ArrayDataset:
     """MNIST as (N, 28, 28, 1) float32 in [0,1] + int32 labels (NHWC for TPU).
 
     Reads the standard idx(.gz) files if present under ``data_dir``; otherwise
     returns a deterministic synthetic surrogate with identical shape/classes
     (``.synthetic`` is set so callers/benchmarks can report it honestly).
+
+    ``raw=True`` returns the images as **uint8** (the on-disk dtype): the
+    device-resident pipeline keeps the dataset at 1/4 the HBM and fuses the
+    ``/255`` normalize into the compiled step (see ``bench.py``).
     """
     data_dir = data_dir or DATA_DIR
     prefix = "train" if split == "train" else "t10k"
@@ -157,23 +178,30 @@ def mnist(split: str = "train", data_dir: str | None = None) -> ArrayDataset:
         img_p = os.path.join(data_dir, f"{prefix}-images-idx3-ubyte{ext}")
         lbl_p = os.path.join(data_dir, f"{prefix}-labels-idx1-ubyte{ext}")
         if os.path.exists(img_p) and os.path.exists(lbl_p):
-            images = _read_idx(img_p).astype(np.float32)[..., None] / 255.0
+            u8 = _read_idx(img_p)[..., None]
             labels = _read_idx(lbl_p).astype(np.int32)
+            images = u8 if raw else u8.astype(np.float32) / 255.0
             return ArrayDataset((images, labels))
     n = 60000 if split == "train" else 10000
     # Fixed constants: hash() is interpreter-randomized and would desync the
     # surrogate across processes/runs. Shared template seed across splits.
     return _synthetic_images(
         n, (28, 28, 1), 10, template_seed=101,
-        noise_seed=1 if split == "train" else 2,
+        noise_seed=1 if split == "train" else 2, raw=raw,
     )
 
 
-def cifar10(split: str = "train", data_dir: str | None = None) -> ArrayDataset:
+def cifar10(
+    split: str = "train",
+    data_dir: str | None = None,
+    *,
+    raw: bool = False,
+) -> ArrayDataset:
     """CIFAR-10 as (N, 32, 32, 3) float32 in [0,1] + int32 labels (NHWC).
 
     Reads the python-pickle batches from ``cifar-10-batches-py`` (or the
     ``.tar.gz``) if present; otherwise a deterministic synthetic surrogate.
+    ``raw=True`` keeps the images uint8 (see :func:`mnist`).
     """
     data_dir = data_dir or DATA_DIR
     batch_dir = os.path.join(data_dir, "cifar-10-batches-py")
@@ -193,16 +221,16 @@ def cifar10(split: str = "train", data_dir: str | None = None) -> ArrayDataset:
                 d = pickle.load(f, encoding="bytes")
             xs.append(d[b"data"])
             ys.extend(d[b"labels"])
-        images = (
+        u8 = (
             np.concatenate(xs)
             .reshape(-1, 3, 32, 32)
             .transpose(0, 2, 3, 1)
-            .astype(np.float32)
-            / 255.0
         )
+        u8 = np.ascontiguousarray(u8)
+        images = u8 if raw else u8.astype(np.float32) / 255.0
         return ArrayDataset((images, np.asarray(ys, dtype=np.int32)))
     n = 50000 if split == "train" else 10000
     return _synthetic_images(
         n, (32, 32, 3), 10, template_seed=103,
-        noise_seed=3 if split == "train" else 4,
+        noise_seed=3 if split == "train" else 4, raw=raw,
     )

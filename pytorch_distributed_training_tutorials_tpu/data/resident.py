@@ -81,9 +81,16 @@ class DeviceResidentLoader(ShardedLoader):
         return self.transform(batch)
 
     def sample_batch(self):
-        """Parent's host sample with ``transform`` applied — model init must
-        see the shapes/dtypes the compiled epoch actually trains on."""
-        return self._apply_transform(super().sample_batch())
+        """A batch-sized host sample with ``transform`` applied — model init
+        must see the shapes/dtypes the compiled epoch actually trains on.
+        Sliced *before* transforming so the whole dataset is never copied."""
+        sample = super().sample_batch()
+        rows = min(len(self.dataset), self.global_batch)
+        if isinstance(sample, tuple):
+            sample = tuple(a[:rows] for a in sample)
+        else:
+            sample = sample[:rows]
+        return self._apply_transform(sample)
 
     def __iter__(self):
         """Streaming iteration (parent semantics) with ``transform`` applied,

@@ -364,6 +364,57 @@ class Trainer:
         dt = time.perf_counter() - t0
         return self._epoch_metrics(epoch, loss, len(loader), dt)
 
+    def run_epochs_fused(self, first_epoch: int, n_epochs: int) -> dict:
+        """Run ``n_epochs`` consecutive epochs as ONE compiled program
+        (device-resident loaders only): the per-epoch index matrices are
+        stacked into a single scan, so launch + final-fetch overhead is paid
+        once per *run* instead of once per epoch. Epoch-seeded reshuffle
+        semantics are identical — each epoch's indices come from the same
+        ``set_epoch`` permutation the per-epoch path uses.
+
+        Returns the last epoch's metrics (with aggregate ``samples_per_sec``
+        over the fused region — the honest end-to-end rate).
+        """
+        loader = self.loader
+        if getattr(loader, "device_arrays", None) is None:
+            raise ValueError("run_epochs_fused requires a device-resident loader")
+        if self._epoch_scan is None:
+            self._epoch_scan = make_epoch_scan(
+                loss=self.loss_name,
+                has_batch_stats=self.has_batch_stats,
+                aux_loss_weight=self.aux_loss_weight,
+                transform=loader.transform,
+            )
+        idx = jnp.concatenate(
+            [
+                loader.epoch_index_array(first_epoch + e)
+                for e in range(n_epochs)
+            ],
+            axis=0,
+        )
+        steps = len(loader)
+        t0 = time.perf_counter()
+        self.state, losses = self._epoch_scan(
+            self.state, idx, loader.device_arrays
+        )
+        losses = jax.device_get(losses)  # one host fetch for the whole run
+        dt = time.perf_counter() - t0
+        for e in range(n_epochs):
+            epoch_losses = losses[e * steps : (e + 1) * steps]
+            log0(
+                f"  epoch {first_epoch + e}: loss "
+                f"{float(epoch_losses[-1]):.4f} (fused scan)"
+            )
+        self.epoch = first_epoch + n_epochs
+        m = self._epoch_metrics(
+            first_epoch + n_epochs - 1,
+            float(losses[-1]),
+            steps * n_epochs,
+            dt,
+        )
+        m["steps"] = steps  # per-epoch steps, like the per-epoch path
+        return m
+
     def _run_epoch(self, epoch: int) -> dict:
         if getattr(self.loader, "device_arrays", None) is not None:
             return self._run_epoch_scanned(epoch)
@@ -474,11 +525,16 @@ class Trainer:
                 self.loss_name, self.has_batch_stats
             )
         has_mask = hasattr(loader, "valid_mask")
-        mask_sharding = (
-            NamedSharding(loader.mesh, PartitionSpec(loader.axis))
-            if has_mask
-            else None
-        )
+        if has_mask and loader.axis in loader.mesh.shape:
+            mask_sharding = NamedSharding(
+                loader.mesh, PartitionSpec(loader.axis)
+            )
+        elif has_mask:
+            # loader on a mesh without its batch axis (replicated batches,
+            # e.g. a stage-only mesh with a custom batch_spec)
+            mask_sharding = NamedSharding(loader.mesh, PartitionSpec())
+        else:
+            mask_sharding = None
         # accumulate device arrays; convert once after the loop so eval
         # dispatch stays async (a float() per batch would sync every step)
         losses, corrects, counts = [], [], []

@@ -160,3 +160,38 @@ def test_streaming_iter_applies_transform(mesh):
     xb_t, _ = next(iter(resident))
     xb, _ = next(iter(plain))
     np.testing.assert_allclose(np.asarray(xb_t), np.asarray(xb) * 2.0, rtol=1e-6)
+
+
+def test_fused_epochs_match_sequential(mesh):
+    """run_epochs_fused must be numerically identical to the per-epoch scan
+    path — same sampler indices, same step math, one launch."""
+    ds = synthetic_regression(256)
+    def make_trainer():
+        loader = DeviceResidentLoader(ds, 8, mesh, seed=0)
+        return Trainer(LinearRegressor(), loader, optax.sgd(1e-2), loss="mse")
+
+    t_seq = make_trainer()
+    for e in range(3):
+        m_seq = t_seq._run_epoch(e)
+    t_fused = make_trainer()
+    m_fused = t_fused.run_epochs_fused(0, 3)
+    assert t_fused.epoch == 3
+    np.testing.assert_allclose(m_fused["loss"], m_seq["loss"], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(t_fused.state.params["Dense_0"]["kernel"]),
+        np.asarray(t_seq.state.params["Dense_0"]["kernel"]),
+        rtol=1e-6,
+    )
+
+
+def test_raw_uint8_dataset_matches_f32(mesh):
+    """raw=True surrogate bytes / 255 == the f32 surrogate (same data, two
+    residencies), so the uint8-resident bench path trains the same task."""
+    f32 = mnist("train")
+    u8 = mnist("train", raw=True)
+    assert u8.arrays[0].dtype == np.uint8
+    assert f32.arrays[0].dtype == np.float32
+    np.testing.assert_allclose(
+        u8.arrays[0][:64].astype(np.float32) / 255.0, f32.arrays[0][:64]
+    )
+    np.testing.assert_array_equal(u8.arrays[1][:64], f32.arrays[1][:64])
