@@ -110,10 +110,12 @@ def main() -> None:
         # slope-timed individual dispatches, which over-reported ~60% on the
         # tunneled runtime vs the XLA device trace; the scanned chain matches
         # the trace's per-step time.)
-        # normalized once outside the chain: this leg isolates the train
-        # step itself (the e2e path fuses the equivalent transform in-scan)
+        # normalized once outside the chain via the loader's jitted transform
+        # (same bf16 dtype semantics as the in-scan path — a host-side numpy
+        # transform would silently promote to f32 and time the wrong step):
+        # this leg isolates the train step itself
         batch = jax.block_until_ready(
-            loader.transform(*next(iter(streaming)))
+            loader._apply_transform(next(iter(streaming)))
         )
         step_fn = _train_step_fn("cross_entropy", has_batch_stats=True)
         chain_len = 256

@@ -431,7 +431,228 @@ where a `stage`-axis `shard_map` schedule would slot in.
 ]
 
 
+# --------------------------------------------------------------------------
+# 04 — scaling out (beyond the reference: FSDP, microbatched pipelines,
+#      elastic restart, scaling efficiency)
+# --------------------------------------------------------------------------
+NB04 = [
+    ("md", """
+# 04 — Scaling out: FSDP, microbatched pipelines, elastic training
+
+The reference *declares* deepspeed and megatron-fsdp in its environment
+(`environment.yml:62-63`) and writes its torchrun script against an elastic
+agent — but never builds any of it. This lesson makes those capabilities
+real, the TPU way: each one is a **sharding recipe over the same named
+mesh**, not a wrapper framework.
+"""),
+    ("code", SETUP),
+    ("md", """
+## FSDP / ZeRO — shard the *parameters*, not just the batch
+DDP keeps every parameter, gradient, and optimizer moment on every chip.
+FSDP shards them over the `data` axis; XLA compiles the all-gather-at-use /
+reduce-scatter schedule from the annotations. Per-chip HBM for everything
+sharded drops to `1/world` — the ZeRO-3 memory curve — while the numerics
+are *identical* to DDP (it's an execution schedule, not a new optimizer).
+"""),
+    ("code", """
+import jax, numpy as np, optax
+from pytorch_distributed_training_tutorials_tpu import create_mesh
+from pytorch_distributed_training_tutorials_tpu.data import ShardedLoader
+from pytorch_distributed_training_tutorials_tpu.data.datasets import ArrayDataset
+from pytorch_distributed_training_tutorials_tpu.models import MLP
+from pytorch_distributed_training_tutorials_tpu.parallel import FSDP
+from pytorch_distributed_training_tutorials_tpu.train import Trainer
+
+mesh = create_mesh()
+rng = np.random.Generator(np.random.PCG64(0))
+labels = rng.integers(0, 4, 512).astype(np.int32)
+centers = rng.standard_normal((4, 64)).astype(np.float32) * 3
+x = centers[labels] + 0.1 * rng.standard_normal((512, 64)).astype(np.float32)
+
+loader = ShardedLoader(ArrayDataset((x, labels)), 8, mesh)
+trainer = Trainer(
+    MLP(features=(256, 4)), loader, optax.adam(1e-3),
+    strategy=FSDP(mesh, min_size=256), loss="cross_entropy",
+)
+trainer.train(3)
+
+k = trainer.state.params["Dense_0"]["kernel"]
+mu = trainer.state.opt_state[0].mu["Dense_0"]["kernel"]
+print("kernel:", k.shape, "spec", k.sharding.spec,
+      "-> per-chip shard", k.addressable_shards[0].data.shape)
+print("adam mu follows:", mu.sharding.spec)
+"""),
+    ("md", """
+Each chip holds 1/8 of the kernel *and* 1/8 of Adam's moments — the audit
+above is the observable. Swap `FSDP(mesh)` for `DataParallel(mesh)` and the
+loss curve is bit-for-bit the same (`tests/test_fsdp.py` pins this).
+
+## Pipeline parallelism with microbatching — one compiled program
+The reference's 2-stage split runs one batch through stage0 then stage1,
+stages idling in turn (lesson 03). The production schedule is **GPipe**:
+split the batch into microbatches that fill and drain the pipeline. With a
+scanned transformer the whole dp x pp schedule is ONE `shard_map` program:
+the layer stack's leading axis is sharded over `stage` (placement = an
+annotation), activations hop stages via `ppermute`, and data parallelism
+rides the `data` axis of the same mesh.
+"""),
+    ("code", """
+import jax.numpy as jnp
+from pytorch_distributed_training_tutorials_tpu.data import synthetic_lm
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    TransformerConfig, TransformerLM,
+)
+from pytorch_distributed_training_tutorials_tpu.parallel import (
+    PipelinedTransformerLM, PipelineParallel,
+)
+
+mesh_pp = create_mesh({"data": 4, "stage": 2})
+cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=4, n_heads=2,
+                        max_seq_len=32, scan_layers=True)
+model = PipelinedTransformerLM(cfg, mesh_pp, num_microbatches=4)
+
+key = jax.random.PRNGKey(0)
+tokens = jax.random.randint(key, (16, 8), 0, 64)
+variables = model.init(key, tokens)
+
+# the schedule reorders compute, not math: identical logits
+ref = TransformerLM(cfg)
+diff = jnp.abs(model.apply(variables, tokens) - ref.apply(variables, tokens))
+print("max |pipelined - unpipelined| =", float(diff.max()))
+
+loader = ShardedLoader(synthetic_lm(size=256, seq_len=16, vocab_size=64),
+                       16, mesh_pp)
+t_pp = Trainer(model, loader, optax.adam(3e-3),
+               strategy=PipelineParallel(mesh_pp, num_microbatches=4),
+               loss="cross_entropy")
+t_pp.train(2)
+qk = t_pp.state.params["layers"]["block"]["attn"]["q_proj"]["kernel"]
+print("4 stacked layers, spec", qk.sharding.spec,
+      "-> resident per stage:", qk.addressable_shards[0].data.shape[0])
+"""),
+    ("md", """
+## Heterogeneous stages: GPipe on sub-mesh columns
+ResNet-style cuts have no common stacked-layer axis to shard, so each stage
+gets one *column* of the `{'data': D, 'stage': S}` grid (its own data-parallel
+sub-mesh); microbatches fill/drain across columns, gradients and BatchNorm
+statistics accumulate and apply once per step — plain gradient accumulation,
+verified against a single-device comparator in `tests/test_gpipe.py`.
+"""),
+    ("code", """
+from pytorch_distributed_training_tutorials_tpu.models import ToyModel
+from pytorch_distributed_training_tutorials_tpu.parallel import GPipe
+
+toy_x = rng.standard_normal((32, 10000)).astype(np.float32)
+toy_y = rng.standard_normal((32, 5)).astype(np.float32)
+pipe = GPipe.from_linen(
+    ToyModel(), toy_x, devices=mesh_pp, num_microbatches=4,
+    loss="mse", optimizer=optax.sgd(1e-3),
+)
+first = float(pipe.train_step(toy_x, toy_y))
+for _ in range(4):
+    last = float(pipe.train_step(toy_x, toy_y))
+print(f"loss {first:.4f} -> {last:.4f} over 5 GPipe steps")
+for line in pipe.placement_audit():
+    print(" ", line)
+"""),
+    ("md", """
+## Elastic restart-and-resume
+torchrun's elastic agent restarts a failed world — *from scratch*, because
+the reference never checkpoints. Here `spawn(max_restarts=N)` gang-aborts
+the world the moment any rank dies, re-forks it with a fresh rendezvous,
+and the Trainer resumes from its latest checkpoint. Below, rank 1 hard-kills
+itself (`os._exit`) after epoch 1 on the first attempt; the relaunched world
+resumes at epoch 2 and finishes all 3 epochs.
+"""),
+    ("code", """
+import subprocess, sys, tempfile, textwrap, os
+import pytorch_distributed_training_tutorials_tpu as pkg
+repo_root = os.path.dirname(os.path.dirname(os.path.abspath(pkg.__file__)))
+
+script = textwrap.dedent('''
+    import json, os, sys
+    import numpy as np
+
+    def worker(rank, workdir):
+        from pytorch_distributed_training_tutorials_tpu.parallel import distributed
+        distributed.init()
+        import optax
+        from pytorch_distributed_training_tutorials_tpu import create_mesh
+        from pytorch_distributed_training_tutorials_tpu.data import (
+            ShardedLoader, synthetic_regression,
+        )
+        from pytorch_distributed_training_tutorials_tpu.models import LinearRegressor
+        from pytorch_distributed_training_tutorials_tpu.train import Trainer
+
+        loader = ShardedLoader(synthetic_regression(256), 32, create_mesh())
+        t = Trainer(LinearRegressor(), loader, optax.sgd(1e-2), loss="mse")
+        ckpt = os.path.join(workdir, "ckpt")
+        sentinel = os.path.join(workdir, "crashed_once")
+        if os.path.exists(ckpt):
+            t.restore(ckpt)
+            print(f"[rank {rank}] resumed at epoch {t.epoch}", flush=True)
+        while t.epoch < 3:
+            t.train(t.epoch + 1)
+            t.save(ckpt)
+            if t.epoch == 2 and rank == 1 and not os.path.exists(sentinel):
+                open(sentinel, "w").write("1")
+                os._exit(17)  # hard crash mid-training
+
+    if __name__ == "__main__":
+        from pytorch_distributed_training_tutorials_tpu.launch import spawn
+        spawn(worker, 2, args=(sys.argv[1],), env_contract=True,
+              platform="cpu", max_restarts=1, join_timeout_s=600)
+        print("RESTART-AND-RESUME OK")
+''')
+
+workdir = tempfile.mkdtemp()
+spath = os.path.join(workdir, "elastic_demo.py")
+open(spath, "w").write(script)
+env = {k: v for k, v in os.environ.items()
+       if k not in ("PALLAS_AXON_POOL_IPS", "TPU_WORKER_HOSTNAMES")}
+env["JAX_PLATFORMS"] = "cpu"
+env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+out = subprocess.run([sys.executable, spath, workdir],
+                     capture_output=True, text=True, timeout=900, env=env)
+print(out.stdout[-2000:])
+assert "RESTART-AND-RESUME OK" in out.stdout, out.stderr[-2000:]
+assert os.path.exists(os.path.join(workdir, "crashed_once"))
+"""),
+    ("md", """
+## Scaling efficiency — the number that matters at pod scale
+Weak scaling: hold per-chip batch fixed, widen the `data` axis, and track
+images/s/chip vs the 1-chip run. Perfect allreduce/backward overlap = 1.0;
+an exposed allreduce shows up directly. (On this CPU mesh the fake devices
+share one core, so efficiency drops mechanically — the harness is what
+transfers to a pod, where the same command targets >=90% at 32 chips,
+`BASELINE.json`.)
+"""),
+    ("code", """
+from pytorch_distributed_training_tutorials_tpu.bench.scaling import report, sweep
+from pytorch_distributed_training_tutorials_tpu.models import MLP as _MLP
+
+def make_batch(global_batch):
+    gx = rng.standard_normal((global_batch, 64)).astype(np.float32)
+    gy = rng.integers(0, 4, global_batch).astype(np.int32)
+    return gx, gy
+
+points = sweep([1, 2, 4], per_device_batch=16,
+               model=_MLP(features=(64, 4)), tx=optax.sgd(1e-2),
+               make_batch=make_batch, n1=2, n2=6)
+for p in points:
+    print(f"  {p.num_chips} chips: {p.images_per_sec_per_chip:,.0f} "
+          f"img/s/chip, efficiency {p.efficiency:.2f}")
+"""),
+    ("md", """
+Every recipe above — FSDP, both pipeline schedules, elastic restart, the
+sweep — is the *same code* on a real pod slice; only the mesh gets wider
+and the collectives move from shared-memory gloo to ICI.
+"""),
+]
+
+
 if __name__ == "__main__":
     build("01_data_parallel.ipynb", NB01)
     build("02_ddp.ipynb", NB02)
     build("03_model_parallel.ipynb", NB03)
+    build("04_scaling_out.ipynb", NB04)

@@ -64,6 +64,11 @@ class DeviceResidentLoader(ShardedLoader):
             )
         super().__init__(dataset, batch_size, mesh, **kwargs)
         self.transform = transform
+        # Host-path twin of the in-scan transform, jitted so dtype semantics
+        # match the compiled epoch exactly: numpy would promote
+        # `x.astype(bfloat16) / 255.0` to float32 on host, while JAX
+        # weak-typing keeps bfloat16 under jit.
+        self._jit_transform = jax.jit(transform) if transform else None
         # Replicated residency: every device holds the dataset, so the
         # per-step gather is local (no collectives). Tutorial-scale datasets
         # are far smaller than HBM; shard-over-data residency is the natural
@@ -74,11 +79,11 @@ class DeviceResidentLoader(ShardedLoader):
         )
 
     def _apply_transform(self, batch):
-        if self.transform is None:
+        if self._jit_transform is None:
             return batch
         if isinstance(batch, tuple):
-            return self.transform(*batch)
-        return self.transform(batch)
+            return self._jit_transform(*batch)
+        return self._jit_transform(batch)
 
     def sample_batch(self):
         """A batch-sized host sample with ``transform`` applied — model init

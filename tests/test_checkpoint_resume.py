@@ -109,3 +109,19 @@ def test_profiler_trace_produces_artifacts(tmp_path):
             t.train(2)
     files = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
     assert any("trace" in os.path.basename(f) for f in files), files
+
+
+def test_device_op_durations_parses_trace(tmp_path):
+    """The trace-analysis utility finds device lanes and aggregates op time
+    (the tool behind the round-2 'step is BN-bound, not conv-bound' and
+    'dispatch slope over-reports on the tunnel' findings)."""
+    logdir = str(tmp_path / "trace2")
+    t = _trainer()
+    t.train(1)
+    with profiling.trace(logdir):
+        t.train(2)
+    durations = profiling.device_op_durations(logdir)
+    assert durations  # found device events
+    assert all(v > 0 for v in durations.values())
+    vals = list(durations.values())
+    assert vals == sorted(vals, reverse=True)  # descending

@@ -14,6 +14,7 @@ NOTEBOOKS = [
     "01_data_parallel.ipynb",
     "02_ddp.ipynb",
     "03_model_parallel.ipynb",
+    "04_scaling_out.ipynb",
 ]
 
 
