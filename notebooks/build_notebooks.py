@@ -561,8 +561,9 @@ torchrun's elastic agent restarts a failed world — *from scratch*, because
 the reference never checkpoints. Here `spawn(max_restarts=N)` gang-aborts
 the world the moment any rank dies, re-forks it with a fresh rendezvous,
 and the Trainer resumes from its latest checkpoint. Below, rank 1 hard-kills
-itself (`os._exit`) after epoch 1 on the first attempt; the relaunched world
-resumes at epoch 2 and finishes all 3 epochs.
+itself (`os._exit`) on the first attempt once epochs 0-1 are checkpointed;
+the relaunched world resumes at epoch 2 (the printed `resumed at epoch 2`)
+and finishes all 3 epochs.
 """),
     ("code", """
 import subprocess, sys, tempfile, textwrap, os
@@ -628,7 +629,7 @@ transfers to a pod, where the same command targets >=90% at 32 chips,
 `BASELINE.json`.)
 """),
     ("code", """
-from pytorch_distributed_training_tutorials_tpu.bench.scaling import report, sweep
+from pytorch_distributed_training_tutorials_tpu.bench.scaling import sweep
 from pytorch_distributed_training_tutorials_tpu.models import MLP as _MLP
 
 def make_batch(global_batch):

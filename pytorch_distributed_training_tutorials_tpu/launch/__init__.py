@@ -27,3 +27,7 @@ from pytorch_distributed_training_tutorials_tpu.launch._spawn import (  # noqa: 
     pick_unused_port,
     spawn,
 )
+from pytorch_distributed_training_tutorials_tpu.launch.pod import (  # noqa: F401
+    launch_pod,
+    pod_run_command,
+)

@@ -1,0 +1,102 @@
+"""TPU-pod launch contract: run the same binary on every host.
+
+The reference's multi-node story is torchrun's rendezvous agent
+(``/root/reference/ddp_gpus_torchrun.py:12-14``). On a Cloud TPU pod the
+agent's whole job — discover peers, assign ranks, point everyone at a
+coordinator — is already done by the TPU runtime metadata:
+``jax.distributed.initialize()`` (via :func:`..parallel.distributed.init`
+with no arguments) autodetects coordinator/num_processes/process_id on every
+pod host. The launch contract therefore collapses to **run the identical
+command on all workers**, which is exactly what
+``gcloud compute tpus tpu-vm ssh --worker=all`` does.
+
+This module provides the command builder (pure, tested) and a thin runner.
+There is nothing else to build: no env injection, no rendezvous server, no
+rank bookkeeping — the SPMD program and the pod metadata carry all of it.
+Elastic restart at pod scale is re-running the same command; combined with
+:meth:`..train.trainer.Trainer.restore` the relaunched world resumes from
+its latest checkpoint (the single-host twin is
+``launch.spawn(..., max_restarts=N)``).
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+from collections.abc import Sequence
+
+
+def pod_run_command(
+    script: str,
+    script_args: Sequence[str] = (),
+    *,
+    tpu_name: str,
+    zone: str,
+    project: str | None = None,
+    worker: str = "all",
+    python: str = "python3",
+    workdir: str | None = None,
+) -> list[str]:
+    """The ``gcloud`` invocation that runs ``script`` on every pod worker.
+
+    Twin of the torchrun command line (``02.ddp_toy_example.ipynb`` cells
+    11-12) with the agent's responsibilities moved into the TPU runtime::
+
+        gcloud compute tpus tpu-vm ssh NAME --zone=Z --worker=all \\
+            --command='python3 train.py --max_epochs 10'
+
+    Returns the argv list (pass to ``subprocess.run`` or print for the
+    operator). Pure function — safe to unit test without gcloud installed.
+    """
+    inner = " ".join(
+        [python, shlex.quote(script), *map(shlex.quote, script_args)]
+    )
+    if workdir:
+        inner = f"cd {shlex.quote(workdir)} && {inner}"
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+        f"--zone={zone}",
+        f"--worker={worker}",
+        f"--command={inner}",
+    ]
+    if project:
+        cmd.insert(5, f"--project={project}")
+    return cmd
+
+
+def launch_pod(
+    script: str,
+    script_args: Sequence[str] = (),
+    *,
+    tpu_name: str,
+    zone: str,
+    max_restarts: int = 0,
+    **kwargs,
+) -> int:
+    """Run ``script`` on all workers of ``tpu_name``; optionally re-run on
+    failure (the pod-scale restart contract — workers resume from their
+    latest checkpoint if the script uses ``Trainer.restore``).
+
+    Returns the final exit code. Requires ``gcloud`` on PATH and SSH access
+    to the pod; raises ``FileNotFoundError`` with a clear message otherwise.
+    """
+    cmd = pod_run_command(
+        script, script_args, tpu_name=tpu_name, zone=zone, **kwargs
+    )
+    for attempt in range(max_restarts + 1):
+        try:
+            rc = subprocess.run(cmd).returncode
+        except FileNotFoundError as e:
+            raise FileNotFoundError(
+                "gcloud not found — launch_pod drives `gcloud compute tpus "
+                "tpu-vm ssh`; install the Cloud SDK or run the printed "
+                f"command manually: {' '.join(map(shlex.quote, cmd))}"
+            ) from e
+        if rc == 0:
+            return 0
+        if attempt < max_restarts:
+            print(
+                f"launch_pod: workers exited {rc}; "
+                f"restarting ({attempt + 1}/{max_restarts})"
+            )
+    return rc

@@ -1,0 +1,31 @@
+"""Pod launch contract: run-the-same-binary-on-every-worker command builder."""
+
+from pytorch_distributed_training_tutorials_tpu.launch import pod_run_command
+
+
+def test_pod_command_shape():
+    cmd = pod_run_command(
+        "train.py",
+        ["--max_epochs", "10", "--batch_size", "32"],
+        tpu_name="my-pod",
+        zone="us-central2-b",
+        workdir="/home/me/repo",
+    )
+    assert cmd[:6] == [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", "my-pod",
+    ]
+    assert "--zone=us-central2-b" in cmd
+    assert "--worker=all" in cmd  # the whole contract: every host, same cmd
+    command = cmd[-1]
+    assert command.startswith("--command=cd /home/me/repo && python3 train.py")
+    assert "--max_epochs 10" in command
+
+
+def test_pod_command_quotes_and_project():
+    cmd = pod_run_command(
+        "a b.py", ["--name", "x y"], tpu_name="p", zone="z", project="proj",
+        worker="0",
+    )
+    assert "--project=proj" in cmd
+    assert "--worker=0" in cmd
+    assert "'a b.py'" in cmd[-1] and "'x y'" in cmd[-1]
