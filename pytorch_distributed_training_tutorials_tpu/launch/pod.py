@@ -80,6 +80,8 @@ def launch_pod(
     Returns the final exit code. Requires ``gcloud`` on PATH and SSH access
     to the pod; raises ``FileNotFoundError`` with a clear message otherwise.
     """
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
     cmd = pod_run_command(
         script, script_args, tpu_name=tpu_name, zone=zone, **kwargs
     )

@@ -38,6 +38,12 @@ from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import 
 from pytorch_distributed_training_tutorials_tpu.parallel.fsdp import (  # noqa: F401
     FSDP,
 )
+from pytorch_distributed_training_tutorials_tpu.parallel.ring_attention import (  # noqa: F401
+    make_ring_attention,
+)
+from pytorch_distributed_training_tutorials_tpu.parallel.ulysses import (  # noqa: F401
+    make_ulysses_attention,
+)
 
 # .auto (orbax checkpointing / auto placement) is imported lazily by users —
 # orbax is a heavyweight import and not needed on the hot path.
