@@ -37,3 +37,6 @@ from pytorch_distributed_training_tutorials_tpu.models.moe import (  # noqa: F40
 from pytorch_distributed_training_tutorials_tpu.models.utils import (  # noqa: F401
     model_size,
 )
+from pytorch_distributed_training_tutorials_tpu.models.generate import (  # noqa: F401
+    generate,
+)

@@ -1,0 +1,128 @@
+"""Autoregressive generation with a KV cache, compiled as one program.
+
+The reference imports ``GenerationConfig`` and loads Llama-7B but never
+generates a single token (``/root/reference/03.model_parallel.ipynb`` cell 0
+imports it, no ``generate`` call anywhere — SURVEY.md section 5.7). This
+module completes the serving story TPU-natively:
+
+- each :class:`..models.transformer.Attention` keeps ``cached_key`` /
+  ``cached_value`` variables (the 'cache' collection) and appends one
+  position per step — O(S) per token instead of O(S^2) re-forwarding;
+- the whole prefill + decode loop is ONE jitted ``lax.scan`` over token
+  positions: no data-dependent Python control flow, static shapes
+  (``max_seq_len`` cache, fixed step count), the XLA-friendly shape. The
+  compiled program is cached per ``(model, prompt_len, total_len,
+  temperature)``, so repeated calls don't retrace;
+- greedy (``temperature=0``) or temperature sampling per step.
+
+Works with any params placement — replicated, tensor-parallel, or int8
+(:class:`..ops.quant.Int8Dense` serving modules) — because the cache and
+the loop are sharding-agnostic pytrees.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_generate(model, p_len: int, total: int, temperature: float):
+    """Jitted prefill+decode scan for fixed lengths (flax modules hash by
+    structure, so this caches across calls with the same config)."""
+
+    @jax.jit
+    def run(params, cache, tokens, key):
+        def step(carry, t):
+            cache, tokens, key = carry
+            b = tokens.shape[0]
+            tok = jax.lax.dynamic_slice(tokens, (0, t), (b, 1))
+            logits, upd = model.apply(
+                {"params": params, "cache": cache},
+                tok,
+                decode=True,
+                mutable=["cache"],
+            )
+            logits = logits[:, -1].astype(jnp.float32)  # (B, vocab)
+            if temperature > 0:
+                k2, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, logits / temperature, axis=-1
+                )
+            else:
+                k2 = key
+                nxt = jnp.argmax(logits, axis=-1)
+            write_pos = t + 1  # in [1, total-1]: always in bounds
+            keep_prompt = write_pos < p_len
+            cur = jax.lax.dynamic_slice(tokens, (0, write_pos), (b, 1))[:, 0]
+            nxt = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt[:, None], (0, write_pos)
+            )
+            return (upd["cache"], tokens, k2), None
+
+        (cache, tokens, _), _ = jax.lax.scan(
+            step, (cache, tokens, key), jnp.arange(total - 1)
+        )
+        return tokens
+
+    return run
+
+
+def generate(
+    model,
+    params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+):
+    """Generate ``max_new_tokens`` continuations of ``prompt``.
+
+    ``model`` is a :class:`..models.transformer.TransformerLM` (or anything
+    with the same ``apply(variables, tokens, decode=True, mutable=['cache'])``
+    contract); ``prompt``: int32 ``(B, P)`` with ``P >= 1``. Returns int32
+    ``(B, P + max_new_tokens)``. The prompt is prefilled through the same
+    one-token decode path the generation loop uses (simple and cache-exact;
+    a batched prefill is a natural later optimization).
+
+    Greedy when ``temperature == 0`` (the default), otherwise softmax
+    sampling at the given temperature using ``rng``.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p_len = prompt.shape
+    if p_len < 1:
+        raise ValueError("prompt must contain at least one token")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    total = p_len + max_new_tokens
+    cfg = model.cfg
+    if total > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt ({p_len}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_seq_len {cfg.max_seq_len}"
+        )
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    # cache shapes without materializing a second param tree: eval_shape
+    # runs the decode-path init abstractly, then zeros are allocated directly
+    cache_shapes = jax.eval_shape(
+        functools.partial(model.init, decode=True),
+        jax.random.PRNGKey(0),
+        jnp.zeros((b, 1), jnp.int32),
+    )["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
+
+    tokens0 = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1
+    )
+    run = _compiled_generate(model, p_len, total, float(temperature))
+    return run(params, cache, tokens0, rng)

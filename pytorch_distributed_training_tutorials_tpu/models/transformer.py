@@ -80,11 +80,16 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(x.dtype)
 
 
-def apply_rope(x: jax.Array, theta: float) -> jax.Array:
-    """Rotary position embedding over the last axis. ``x``: (B, S, H, D)."""
+def apply_rope(x: jax.Array, theta: float, offset=0) -> jax.Array:
+    """Rotary position embedding over the last axis. ``x``: (B, S, H, D).
+
+    ``offset`` shifts the positions (scalar, may be traced) — incremental
+    decoding applies rope at the token's *global* position while S == 1.
+    """
     seq_len, half = x.shape[1], x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    angles = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    pos = offset + jnp.arange(seq_len, dtype=jnp.float32)
+    angles = pos[:, None] * freqs[None, :]
     cos = jnp.cos(angles)[None, :, None, :]  # (1, S, 1, half)
     sin = jnp.sin(angles)[None, :, None, :]
     x32 = x.astype(jnp.float32)
@@ -115,17 +120,73 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         h, d = cfg.n_heads, cfg.head_dim
         proj = lambda name: nn.DenseGeneral(  # noqa: E731
             (h, d), axis=-1, use_bias=False, dtype=cfg.dtype, name=name
         )
-        q = apply_rope(proj("q_proj")(x), cfg.rope_theta)
-        k = apply_rope(proj("k_proj")(x), cfg.rope_theta)
+        q_raw = proj("q_proj")(x)
+        k_raw = proj("k_proj")(x)
         v = proj("v_proj")(x)
-        attn = cfg.attention_fn if cfg.attention_fn is not None else causal_attention
-        out = attn(q, k, v)
+
+        if decode:
+            # incremental decoding: one token in, KV appended to the cache,
+            # attention over the cache prefix. Cache tensors are zero-init
+            # on the first (shape-init) apply and thereafter carry state.
+            # Contract: the caller drives at most max_seq_len steps
+            # (generate() enforces; past that, dynamic_update_slice would
+            # clamp the write index and silently corrupt the last slot).
+            # Note decode always uses this dense cached path — a custom
+            # cfg.attention_fn (ring/Ulysses) governs training/prefill
+            # only; a *non-equivalent* attention_fn (e.g. sliding window)
+            # would need its own decode rule.
+            b = x.shape[0]
+            assert x.shape[1] == 1, "decode=True expects one token at a time"
+            cached_k = self.variable(
+                "cache", "cached_key",
+                jnp.zeros, (b, cfg.max_seq_len, h, d), k_raw.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_value",
+                jnp.zeros, (b, cfg.max_seq_len, h, d), v.dtype,
+            )
+            idx = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32),
+            )
+            pos = idx.value
+            q = apply_rope(q_raw, cfg.rope_theta, offset=pos)
+            k = apply_rope(k_raw, cfg.rope_theta, offset=pos)
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k, (0, pos, 0, 0)
+            )
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v, (0, pos, 0, 0)
+            )
+            idx.value = pos + 1
+            # attend over the whole cache, masking positions beyond `pos`
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, cached_k.value,
+                preferred_element_type=jnp.float32,
+            ) / jnp.sqrt(jnp.float32(d))
+            valid = jnp.arange(cfg.max_seq_len) <= pos  # (max_len,)
+            scores = jnp.where(
+                valid[None, None, None, :], scores, jnp.float32(-1e30)
+            )
+            weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+            out = jnp.einsum(
+                "bhqk,bkhd->bqhd", weights, cached_v.value
+            )
+        else:
+            q = apply_rope(q_raw, cfg.rope_theta)
+            k = apply_rope(k_raw, cfg.rope_theta)
+            attn = (
+                cfg.attention_fn
+                if cfg.attention_fn is not None
+                else causal_attention
+            )
+            out = attn(q, k, v)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
             name="o_proj",
@@ -150,9 +211,11 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(RMSNorm(name="attn_norm")(x))
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(name="attn_norm")(x), decode=decode
+        )
         if cfg.moe_experts > 0:
             ffn = MoEFFN(
                 num_experts=cfg.moe_experts,
@@ -171,10 +234,11 @@ class _ScanCell(nn.Module):
     """``Block`` adapted to ``nn.scan``'s (carry, out) contract."""
 
     cfg: TransformerConfig
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, _):
-        return Block(self.cfg, name="block")(x), None
+        return Block(self.cfg, name="block")(x, decode=self.decode), None
 
 
 class TransformerLM(nn.Module):
@@ -183,7 +247,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, decode: bool = False):
         cfg = self.cfg
         if tokens.shape[1] > cfg.max_seq_len:
             raise ValueError(
@@ -200,16 +264,21 @@ class TransformerLM(nn.Module):
             stack = nn.scan(
                 cell,
                 # 'losses' rides along axis 0 so per-layer sown values (MoE
-                # load balancing) survive the scan instead of being dropped
-                variable_axes={"params": 0, "losses": 0},
+                # load balancing) survive the scan instead of being dropped;
+                # 'cache' stacks each layer's KV cache the same way
+                variable_axes={"params": 0, "losses": 0, "cache": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
-            )(cfg, name="layers")
+            )(cfg, decode, name="layers")
             x, _ = stack(x, None)
         else:
-            block_cls = nn.remat(Block) if cfg.remat else Block
+            # decode is a Python bool steering cache behavior — it must stay
+            # static under remat (arg 2 of __call__ counting self)
+            block_cls = (
+                nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
+            )
             for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f"block_{i}")(x)
+                x = block_cls(cfg, name=f"block_{i}")(x, decode)
         x = RMSNorm(name="final_norm")(x)
         return nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"

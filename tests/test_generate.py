@@ -1,0 +1,127 @@
+"""KV-cache generation: cache-exactness vs full re-forward, sampling, LM demo.
+
+The reference loads Llama and imports GenerationConfig without ever
+generating (SURVEY.md 5.7); these tests pin this framework's decode path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.models import (
+    TransformerConfig,
+    TransformerLM,
+)
+from pytorch_distributed_training_tutorials_tpu.models.generate import generate
+
+
+def _model(scan_layers=False, **kw):
+    base = dict(
+        vocab_size=32, d_model=32, n_layers=2, n_heads=4, max_seq_len=32,
+        scan_layers=scan_layers,
+    )
+    base.update(kw)
+    cfg = TransformerConfig(**base)
+    model = TransformerLM(cfg)
+    tokens = jnp.zeros((2, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    return model, params
+
+
+def _oracle_greedy(model, params, prompt, max_new):
+    """Re-forward the full prefix each step (no cache) — the ground truth."""
+    tokens = jnp.asarray(prompt, jnp.int32)
+    for _ in range(max_new):
+        logits = model.apply({"params": params}, tokens)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        tokens = jnp.concatenate(
+            [tokens, nxt[:, None].astype(jnp.int32)], axis=1
+        )
+    return tokens
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_cached_decode_matches_full_reforward(scan_layers):
+    """Greedy generation through the KV cache must equal argmax decoding by
+    re-running the full prefix — the cache is an optimization, not a model."""
+    model, params = _model(scan_layers=scan_layers)
+    rng = np.random.Generator(np.random.PCG64(0))
+    prompt = jnp.asarray(rng.integers(0, 32, (2, 5)), jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=8)
+    ref = _oracle_greedy(model, params, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # prompt is preserved verbatim
+    np.testing.assert_array_equal(np.asarray(out[:, :5]), np.asarray(prompt))
+
+
+def test_single_decode_step_logits_match_full_forward():
+    """One cached decode step at position t reproduces the full forward's
+    logits at position t (float tolerance)."""
+    model, params = _model()
+    rng = np.random.Generator(np.random.PCG64(1))
+    tokens = jnp.asarray(rng.integers(0, 32, (1, 6)), jnp.int32)
+
+    full = model.apply({"params": params}, tokens)  # (1, 6, vocab)
+    cache = jax.tree_util.tree_map(
+        jnp.zeros_like,
+        model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32), decode=True
+        )["cache"],
+    )
+    step_logits = []
+    for t in range(6):
+        lg, upd = model.apply(
+            {"params": params, "cache": cache},
+            tokens[:, t : t + 1],
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = upd["cache"]
+        step_logits.append(lg[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(jnp.stack(step_logits, axis=1)),
+        np.asarray(full),
+        rtol=2e-4,
+        atol=2e-4,
+    )
+
+
+def test_sampling_is_seeded_and_in_vocab():
+    model, params = _model()
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    a = generate(model, params, prompt, 6, temperature=1.0,
+                 rng=jax.random.PRNGKey(7))
+    b = generate(model, params, prompt, 6, temperature=1.0,
+                 rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # seeded
+    assert int(jnp.max(a)) < 32 and int(jnp.min(a)) >= 0
+
+
+def test_generate_validates_lengths_and_rng():
+    model, params = _model()
+    prompt = jnp.zeros((1, 30), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds"):
+        generate(model, params, prompt, 10)
+    with pytest.raises(ValueError, match="requires rng"):
+        generate(model, params, prompt, 1, temperature=0.5, rng=None)
+
+
+def test_generate_rejects_empty_prompt():
+    model, params = _model()
+    with pytest.raises(ValueError, match="at least one token"):
+        generate(model, params, jnp.zeros((1, 0), jnp.int32), 4)
+
+
+def test_repeated_calls_reuse_compiled_program():
+    from pytorch_distributed_training_tutorials_tpu.models.generate import (
+        _compiled_generate,
+    )
+
+    model, params = _model()
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    _compiled_generate.cache_clear()
+    generate(model, params, prompt, 4)
+    generate(model, params, prompt, 4)
+    info = _compiled_generate.cache_info()
+    assert info.misses == 1 and info.hits == 1
