@@ -29,6 +29,18 @@ import jax.numpy as jnp
 
 
 @functools.lru_cache(maxsize=64)
+def _cache_shapes(model, b: int):
+    """Abstract cache pytree for batch ``b`` — eval_shape traces the
+    decode-path init without materializing params; cached so repeated
+    generate() calls pay no per-call tracing."""
+    return jax.eval_shape(
+        functools.partial(model.init, decode=True),
+        jax.random.PRNGKey(0),
+        jnp.zeros((b, 1), jnp.int32),
+    )["cache"]
+
+
+@functools.lru_cache(maxsize=64)
 def _compiled_generate(model, p_len: int, total: int, temperature: float):
     """Jitted prefill+decode scan for fixed lengths (flax modules hash by
     structure, so this caches across calls with the same config)."""
@@ -84,7 +96,8 @@ def generate(
 
     ``model`` is a :class:`..models.transformer.TransformerLM` (or anything
     with the same ``apply(variables, tokens, decode=True, mutable=['cache'])``
-    contract); ``prompt``: int32 ``(B, P)`` with ``P >= 1``. Returns int32
+    contract AND a ``.cfg.max_seq_len`` attribute bounding the cache);
+    ``prompt``: int32 ``(B, P)`` with ``P >= 1``. Returns int32
     ``(B, P + max_new_tokens)``. The prompt is prefilled through the same
     one-token decode path the generation loop uses (simple and cache-exact;
     a batched prefill is a natural later optimization).
@@ -110,15 +123,8 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    # cache shapes without materializing a second param tree: eval_shape
-    # runs the decode-path init abstractly, then zeros are allocated directly
-    cache_shapes = jax.eval_shape(
-        functools.partial(model.init, decode=True),
-        jax.random.PRNGKey(0),
-        jnp.zeros((b, 1), jnp.int32),
-    )["cache"]
     cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        lambda s: jnp.zeros(s.shape, s.dtype), _cache_shapes(model, b)
     )
 
     tokens0 = jnp.concatenate(

@@ -98,8 +98,13 @@ def apply_rope(x: jax.Array, theta: float, offset=0) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Dense causal softmax attention; (B, S, H, D) in and out.
+def masked_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Scaled-dot-product attention with an explicit boolean mask
+    (broadcastable to the (B, H, Q, K) score shape) — the single copy of
+    the attention math shared by training/prefill (causal mask) and cached
+    decode (prefix mask).
 
     Scores accumulate in float32 on the MXU (``preferred_element_type``), the
     softmax runs in float32, and the context matmul returns to the compute
@@ -109,11 +114,16 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) / jnp.sqrt(jnp.float32(d))
-    s = q.shape[1]
-    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))
-    scores = jnp.where(mask[None, None, :, :], scores, jnp.float32(-1e30))
+    scores = jnp.where(mask, scores, jnp.float32(-1e30))
     weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Dense causal softmax attention; (B, S, H, D) in and out."""
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))[None, None, :, :]
+    return masked_attention(q, k, v, mask)
 
 
 class Attention(nn.Module):
@@ -165,18 +175,12 @@ class Attention(nn.Module):
                 cached_v.value, v, (0, pos, 0, 0)
             )
             idx.value = pos + 1
-            # attend over the whole cache, masking positions beyond `pos`
-            scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, cached_k.value,
-                preferred_element_type=jnp.float32,
-            ) / jnp.sqrt(jnp.float32(d))
+            # attend over the whole cache, masking positions beyond `pos`;
+            # same math as training/prefill via the shared helper
             valid = jnp.arange(cfg.max_seq_len) <= pos  # (max_len,)
-            scores = jnp.where(
-                valid[None, None, None, :], scores, jnp.float32(-1e30)
-            )
-            weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-            out = jnp.einsum(
-                "bhqk,bkhd->bqhd", weights, cached_v.value
+            out = masked_attention(
+                q, cached_k.value, cached_v.value,
+                valid[None, None, None, :],
             )
         else:
             q = apply_rope(q_raw, cfg.rope_theta)

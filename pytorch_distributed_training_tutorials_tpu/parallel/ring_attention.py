@@ -37,7 +37,10 @@ from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (
     SEQ_AXIS,
 )
 
-NEG_INF = jnp.float32(-jnp.inf)
+# plain float, NOT jnp.float32(...): creating a jax array at import time
+# would initialize the XLA backend, which breaks multi-process workers that
+# must call jax.distributed.initialize() before any JAX computation
+NEG_INF = float("-inf")
 
 
 def _qkv_spec(mesh: Mesh, data_axis: str, seq_axis: str, model_axis: str) -> P:

@@ -1,0 +1,41 @@
+"""Importing the package must NOT initialize the XLA backend.
+
+Multi-process workers call jax.distributed.initialize() AFTER importing the
+framework; any module-level jax computation (even `jnp.float32(-inf)`)
+initializes the backend first and breaks every spawn/torchrun world with
+"initialize() must be called before any JAX calls". Regression guard for
+the round-2 ring-attention NEG_INF incident.
+"""
+
+import os
+import subprocess
+import sys
+
+CHILD = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge
+import pytorch_distributed_training_tutorials_tpu
+import pytorch_distributed_training_tutorials_tpu.parallel
+import pytorch_distributed_training_tutorials_tpu.models
+import pytorch_distributed_training_tutorials_tpu.data
+import pytorch_distributed_training_tutorials_tpu.train
+import pytorch_distributed_training_tutorials_tpu.launch
+import pytorch_distributed_training_tutorials_tpu.bench.harness
+import pytorch_distributed_training_tutorials_tpu.utils.profiling
+assert not xla_bridge._backends, (
+    "package import initialized the XLA backend: %s" % xla_bridge._backends
+)
+print("IMPORT_PURE")
+"""
+
+
+def test_package_import_does_not_initialize_backend():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", CHILD],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "IMPORT_PURE" in out.stdout
