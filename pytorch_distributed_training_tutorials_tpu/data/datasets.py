@@ -208,7 +208,10 @@ def cifar10(
     tar_path = os.path.join(data_dir, "cifar-10-python.tar.gz")
     if not os.path.isdir(batch_dir) and os.path.exists(tar_path):
         with tarfile.open(tar_path) as t:
-            t.extractall(data_dir)
+            try:
+                t.extractall(data_dir, filter="data")
+            except TypeError:  # filter= needs >= 3.10.12 / 3.11.4
+                t.extractall(data_dir)
     if os.path.isdir(batch_dir):
         names = (
             [f"data_batch_{i}" for i in range(1, 6)]

@@ -37,6 +37,7 @@ from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import 
 )
 from pytorch_distributed_training_tutorials_tpu.parallel.fsdp import (  # noqa: F401
     FSDP,
+    HybridFSDP,
 )
 from pytorch_distributed_training_tutorials_tpu.parallel.ring_attention import (  # noqa: F401
     make_ring_attention,

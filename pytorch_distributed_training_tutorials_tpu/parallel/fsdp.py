@@ -33,13 +33,19 @@ from pytorch_distributed_training_tutorials_tpu.parallel.mesh import DATA_AXIS
 from pytorch_distributed_training_tutorials_tpu.utils.tree import keystr
 
 
-def shard_dim_for(shape: tuple[int, ...], world: int, min_size: int) -> int | None:
+def shard_dim_for(
+    shape: tuple[int, ...],
+    world: int,
+    min_size: int,
+    exclude: tuple[int, ...] = (),
+) -> int | None:
     """Pick the dimension to shard over ``world`` devices, or None.
 
     The *largest* dimension divisible by ``world`` wins (ties -> the earliest),
     maximizing the per-device memory saving; arrays smaller than ``min_size``
     elements stay replicated (sharding a bias of 10 floats buys nothing and
-    costs an all-gather dispatch).
+    costs an all-gather dispatch). ``exclude`` lists dimensions already
+    claimed by another axis (HybridFSDP's TP pass).
     """
     if not shape:
         return None
@@ -50,6 +56,8 @@ def shard_dim_for(shape: tuple[int, ...], world: int, min_size: int) -> int | No
         return None
     best: int | None = None
     for i, d in enumerate(shape):
+        if i in exclude:
+            continue
         if d % world == 0 and (best is None or d > shape[best]):
             best = i
     return best
@@ -126,6 +134,96 @@ class FSDP:
             path = keystr(kp)
             spec = self.spec_for(tuple(leaf.shape))
             lines.append(f"{path}: {tuple(leaf.shape)} -> {tuple(spec)}")
+
+        jax.tree_util.tree_map_with_path(visit, params)
+        return lines
+
+
+class HybridFSDP(FSDP):
+    """2D sharding: tensor-parallel rules over ``model``, FSDP over ``data``.
+
+    The production llama-style layout: each weight is first matched against
+    the TP rules (:data:`..models.transformer.TP_RULES`-style path regexes
+    -> specs over the ``model`` axis); whatever dimension the rules leave
+    unsharded is then eligible for FSDP's shape-driven shard over ``data``.
+    Rule-matched-and-fully-replicated or unmatched leaves fall back to plain
+    FSDP. Gradient reduce-scatter rides ``data``; activation collectives
+    ride ``model`` (lay ``model`` innermost so they stay on ICI).
+
+    Drop-in for the other strategies in the Trainer::
+
+        mesh = create_mesh({'data': D, 'model': M})
+        strategy = HybridFSDP(mesh, TP_RULES)
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        rules,
+        *,
+        axis: str = DATA_AXIS,
+        model_axis: str = "model",
+        min_size: int = 1024,
+    ):
+        super().__init__(mesh, axis, min_size=min_size)
+        from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import (
+            spec_for_path,
+        )
+
+        self.rules = list(rules)
+        self.model_axis = model_axis
+        self._spec_for_path = spec_for_path
+
+    def _leaf_sharding(self, leaf, key_path=None) -> NamedSharding:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if not shape:
+            return NamedSharding(self.mesh, PartitionSpec())
+        path = keystr(key_path) if key_path is not None else ""
+        tp_spec = tuple(
+            self._spec_for_path(
+                path, len(shape), self.rules, mesh=self.mesh
+            )
+        )
+        tp_spec = tp_spec + (None,) * (len(shape) - len(tp_spec))
+        # FSDP pass: shard the largest dim the TP rules left unclaimed
+        claimed = tuple(i for i, p in enumerate(tp_spec) if p is not None)
+        best = shard_dim_for(
+            shape, self.num_devices, self.min_size, exclude=claimed
+        )
+        parts = list(tp_spec)
+        if best is not None:
+            parts[best] = self.axis
+        return NamedSharding(self.mesh, PartitionSpec(*parts))
+
+    def variable_shardings(self, abstract_variables):
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: self._leaf_sharding(leaf, kp),
+            abstract_variables,
+        )
+
+    def shard_state(self, state):
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: jax.device_put(
+                leaf, self._leaf_sharding(leaf, kp)
+            ),
+            state,
+        )
+
+    def spec_for(self, shape):  # shape-only: ambiguous for 2D layouts
+        raise NotImplementedError(
+            "HybridFSDP placements depend on the param path, not shape "
+            "alone — use variable_shardings/audit"
+        )
+
+    def audit(self, params) -> list[str]:
+        """Path -> spec lines reflecting the actual 2D placement."""
+        lines: list[str] = []
+
+        def visit(kp, leaf):
+            spec = self._leaf_sharding(leaf, kp).spec
+            lines.append(
+                f"{keystr(kp)}: {tuple(leaf.shape)} -> {tuple(spec)}"
+            )
 
         jax.tree_util.tree_map_with_path(visit, params)
         return lines

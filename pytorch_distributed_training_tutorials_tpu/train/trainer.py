@@ -109,6 +109,38 @@ def _compute_loss(loss: str, logits, targets):
     raise ValueError(f"unknown loss {loss!r}")
 
 
+def _make_loss_fn(
+    loss: str, has_batch_stats: bool, aux_loss_weight: float
+):
+    """The single definition of the training objective, shared by the plain
+    step, the epoch scan, and the gradient-accumulation step — one place
+    owns the batch_stats/mutable/aux-loss contract."""
+
+    def loss_fn(params, state: TrainState, batch):
+        x, y = batch
+        variables = {"params": params}
+        mutable = []
+        kwargs = {}
+        if has_batch_stats:
+            variables["batch_stats"] = state.batch_stats
+            mutable.append("batch_stats")
+            kwargs["train"] = True
+        if aux_loss_weight:
+            mutable.append("losses")
+        if mutable:
+            out, updates = state.apply_fn(
+                variables, x, mutable=mutable, **kwargs
+            )
+        else:
+            out, updates = state.apply_fn(variables, x), {}
+        loss_val = _compute_loss(loss, out, y)
+        if aux_loss_weight:
+            loss_val = loss_val + aux_loss_weight * moe_aux_loss(updates)
+        return loss_val, updates.get("batch_stats")
+
+    return loss_fn
+
+
 def _train_step_fn(
     loss: str = "cross_entropy",
     has_batch_stats: bool = False,
@@ -117,34 +149,12 @@ def _train_step_fn(
     """The raw (unjitted) SPMD train step, shared by :func:`make_train_step`
     (jit per step — streaming loaders) and :func:`make_epoch_scan` (one jit
     per epoch — device-resident datasets)."""
+    loss_fn = _make_loss_fn(loss, has_batch_stats, aux_loss_weight)
 
     def step_fn(state: TrainState, batch):
-        x, y = batch
-
-        def loss_fn(params):
-            variables = {"params": params}
-            mutable = []
-            kwargs = {}
-            if has_batch_stats:
-                variables["batch_stats"] = state.batch_stats
-                mutable.append("batch_stats")
-                kwargs["train"] = True
-            if aux_loss_weight:
-                mutable.append("losses")
-            if mutable:
-                out, updates = state.apply_fn(
-                    variables, x, mutable=mutable, **kwargs
-                )
-            else:
-                out, updates = state.apply_fn(variables, x), {}
-            loss_val = _compute_loss(loss, out, y)
-            if aux_loss_weight:
-                loss_val = loss_val + aux_loss_weight * moe_aux_loss(updates)
-            return loss_val, updates.get("batch_stats")
-
-        (loss_val, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+        (loss_val, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state, batch)
         updates, new_opt_state = state.tx.update(
             grads, state.opt_state, state.params
         )
@@ -164,6 +174,7 @@ def make_train_step(
     loss: str = "cross_entropy",
     has_batch_stats: bool = False,
     aux_loss_weight: float = 0.0,
+    grad_accum_steps: int = 1,
 ):
     """Build the jitted SPMD train step (donated state).
 
@@ -175,11 +186,79 @@ def make_train_step(
 
     ``aux_loss_weight`` > 0 collects the model's sown ``"losses"`` collection
     (MoE load-balancing) and adds it, weighted, to the objective.
+
+    ``grad_accum_steps`` > 1 splits the batch into that many microbatches
+    inside the compiled step (a ``lax.scan``), averaging gradients (and
+    BatchNorm statistics) before ONE optimizer update — the standard trade
+    of peak activation memory for step time when the global batch exceeds
+    HBM. Batch dim 0 must divide evenly.
     """
-    return jax.jit(
-        _train_step_fn(loss, has_batch_stats, aux_loss_weight),
-        donate_argnums=0,
-    )
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    if grad_accum_steps == 1:
+        return jax.jit(
+            _train_step_fn(loss, has_batch_stats, aux_loss_weight),
+            donate_argnums=0,
+        )
+
+    loss_fn = _make_loss_fn(loss, has_batch_stats, aux_loss_weight)
+
+    def step_fn(state: TrainState, batch):
+        n = grad_accum_steps
+        # strided split (microbatch m = rows m::n): with dim 0 sharded over
+        # `data` in contiguous per-device blocks, every microbatch stays
+        # evenly spread over all devices (a contiguous (n, B/n) reshape
+        # would hand each microbatch to a fraction of the mesh and force a
+        # reshard per scan iteration)
+        micro = jax.tree_util.tree_map(
+            lambda a: a.reshape(
+                a.shape[0] // n, n, *a.shape[1:]
+            ).swapaxes(0, 1),
+            batch,
+        )
+
+        def body(acc, mb):
+            g_acc, s_acc, l_acc = acc
+            (loss_val, new_stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, state, mb)
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+            if has_batch_stats:
+                s_acc = jax.tree_util.tree_map(jnp.add, s_acc, new_stats)
+            return (g_acc, s_acc, l_acc + loss_val), None
+
+        zeros_g = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        zeros_s = (
+            jax.tree_util.tree_map(
+                lambda a: jnp.zeros_like(a, jnp.float32), state.batch_stats
+            )
+            if has_batch_stats
+            else None
+        )
+        (g_sum, s_sum, l_sum), _ = jax.lax.scan(
+            body, (zeros_g, zeros_s, jnp.float32(0)), micro
+        )
+        inv = 1.0 / n
+        grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+        updates, new_opt_state = state.tx.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=jax.tree_util.tree_map(
+                lambda s, old: (s * inv).astype(old.dtype),
+                s_sum,
+                state.batch_stats,
+            )
+            if has_batch_stats
+            else state.batch_stats,
+        )
+        return new_state, {"loss": l_sum * inv}
+
+    return jax.jit(step_fn, donate_argnums=0)
 
 
 def make_epoch_scan(

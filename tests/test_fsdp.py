@@ -115,3 +115,84 @@ def test_fsdp_audit_lines():
     ]
     lines = fsdp.audit(params)
     assert any("kernel" in ln and "'data'" in ln for ln in lines)
+
+
+def test_hybrid_fsdp_tp_2d_sharding():
+    """2D llama-style layout: TP rules claim the model axis, FSDP shards a
+    remaining dim over data — one weight, two mesh axes."""
+    from pytorch_distributed_training_tutorials_tpu.data import synthetic_lm
+    from pytorch_distributed_training_tutorials_tpu.models import (
+        TP_RULES,
+        TransformerConfig,
+        TransformerLM,
+    )
+    from pytorch_distributed_training_tutorials_tpu.parallel.fsdp import HybridFSDP
+
+    mesh = create_mesh({"data": 4, "model": 2})
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=4, max_seq_len=64
+    )
+    strategy = HybridFSDP(mesh, TP_RULES, min_size=256)
+    loader = ShardedLoader(
+        synthetic_lm(size=128, seq_len=16, vocab_size=64), 4, mesh
+    )
+    trainer = Trainer(
+        TransformerLM(cfg), loader, optax.adam(3e-3),
+        strategy=strategy, loss="cross_entropy",
+    )
+    # gate_proj kernel (64, 256): TP rule puts 'model' on dim 1, FSDP adds
+    # 'data' on dim 0 -> fully 2D-sharded weight
+    gk = trainer.state.params["block_0"]["mlp"]["gate_proj"]["kernel"]
+    assert gk.sharding.spec == PartitionSpec("data", "model"), gk.sharding
+    assert gk.addressable_shards[0].data.shape == (64 // 4, 256 // 2)
+    # adam moments follow the same 2D layout
+    mu = trainer.state.opt_state[0].mu["block_0"]["mlp"]["gate_proj"]["kernel"]
+    assert mu.sharding.spec == PartitionSpec("data", "model")
+    first = trainer._run_epoch(0)
+    last = trainer.train(3)
+    assert last["loss"] < first["loss"]
+    # the audit reports the true 2D placement (path-aware, not shape-only)
+    lines = strategy.audit(jax.device_get(trainer.state.params))
+    assert any(
+        "gate_proj/kernel" in ln and "('data', 'model')" in ln
+        for ln in lines
+    ), lines[:5]
+
+
+def test_hybrid_fsdp_matches_data_parallel_numerics():
+    """2D resharding is an execution layout, not a different optimizer."""
+    from pytorch_distributed_training_tutorials_tpu.models import (
+        TP_RULES,
+        TransformerConfig,
+        TransformerLM,
+    )
+    from pytorch_distributed_training_tutorials_tpu.parallel.fsdp import HybridFSDP
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=32, n_layers=1, n_heads=2, max_seq_len=16
+    )
+    model = TransformerLM(cfg)
+    rng = np.random.Generator(np.random.PCG64(0))
+    x = rng.integers(0, 32, (16, 8)).astype(np.int32)
+    y = np.ascontiguousarray(
+        np.concatenate([x[:, 1:], x[:, :1]], axis=1)
+    ).astype(np.int32)
+
+    def run(strategy):
+        state = create_train_state(
+            model, optax.adam(1e-3), x, strategy=strategy, seed=0
+        )
+        step = make_train_step(loss="cross_entropy")
+        losses = []
+        for _ in range(3):
+            state, m = step(
+                state, (strategy.shard_batch(x), strategy.shard_batch(y))
+            )
+            losses.append(float(m["loss"]))
+        return losses
+
+    mesh2d = create_mesh({"data": 4, "model": 2})
+    mesh_dp = create_mesh({"data": 8})
+    l_hybrid = run(HybridFSDP(mesh2d, TP_RULES, min_size=64))
+    l_dp = run(DataParallel(mesh_dp))
+    np.testing.assert_allclose(l_hybrid, l_dp, rtol=1e-4)

@@ -168,3 +168,62 @@ def test_valid_mask_counts():
     )
     assert total_real == 100
     assert loader.valid_mask(0).shape == (32,)  # global batch, replica-major
+
+
+def test_grad_accum_matches_full_batch():
+    """grad_accum_steps=N inside the compiled step == one full-batch step
+    (same mean gradient; BN stats averaged like tests/test_gpipe.py's rule)."""
+    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
+        create_train_state,
+        make_train_step,
+    )
+
+    mesh = create_mesh({"data": 8})
+    dp = DataParallel(mesh)
+    model = MLP(features=(32, 4))
+    rng = np.random.Generator(np.random.PCG64(0))
+    x = rng.standard_normal((32, 16)).astype(np.float32)
+    y = rng.integers(0, 4, 32).astype(np.int32)
+    batch = (dp.shard_batch(x), dp.shard_batch(y))
+
+    def run(accum):
+        import optax
+
+        state = create_train_state(
+            model, optax.sgd(0.1), x, strategy=dp, seed=0
+        )
+        step = make_train_step(loss="cross_entropy", grad_accum_steps=accum)
+        state, m = step(state, batch)
+        return float(m["loss"]), jax.device_get(state.params)
+
+    loss1, params1 = run(1)
+    loss4, params4 = run(4)
+    np.testing.assert_allclose(loss1, loss4, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+        params1,
+        params4,
+    )
+
+
+def test_grad_accum_with_batch_stats_runs():
+    from pytorch_distributed_training_tutorials_tpu.models import resnet18
+    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
+        create_train_state,
+        make_train_step,
+    )
+    import optax
+
+    mesh = create_mesh({"data": 8})
+    dp = DataParallel(mesh)
+    model = resnet18(num_classes=10, stem="cifar")
+    rng = np.random.Generator(np.random.PCG64(1))
+    x = rng.standard_normal((32, 8, 8, 3)).astype(np.float32)
+    y = rng.integers(0, 10, 32).astype(np.int32)
+    state = create_train_state(model, optax.sgd(0.1), x, strategy=dp)
+    step = make_train_step(
+        loss="cross_entropy", has_batch_stats=True, grad_accum_steps=2
+    )
+    state, m = step(state, (dp.shard_batch(x), dp.shard_batch(y)))
+    assert np.isfinite(float(m["loss"]))
+    assert int(state.step) == 1
