@@ -103,7 +103,9 @@ class FSDP:
         parts[dim] = self.axis
         return PartitionSpec(*parts)
 
-    def _leaf_sharding(self, leaf) -> NamedSharding:
+    def _leaf_sharding(self, leaf, key_path=None) -> NamedSharding:
+        """Placement for one leaf. Base FSDP is shape-driven and ignores
+        ``key_path``; subclasses (HybridFSDP) consult it."""
         shape = tuple(getattr(leaf, "shape", ()) or ())
         if not shape:
             return self._replicated
@@ -111,15 +113,20 @@ class FSDP:
 
     def variable_shardings(self, abstract_variables):
         """Pytree of NamedShardings (the ``out_shardings`` for a sharded
-        ``model.init``) — every leaf placed by shape alone."""
-        return jax.tree_util.tree_map(self._leaf_sharding, abstract_variables)
+        ``model.init``)."""
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: self._leaf_sharding(leaf, kp),
+            abstract_variables,
+        )
 
     def shard_state(self, state):
         """Place an existing train state: params *and* optimizer moments
-        follow the shape rule (ZeRO-1's optimizer sharding falls out of
+        follow the same rule (ZeRO-1's optimizer sharding falls out of
         ZeRO-3's because optax moments mirror param shapes)."""
-        return jax.tree_util.tree_map(
-            lambda leaf: jax.device_put(leaf, self._leaf_sharding(leaf)),
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: jax.device_put(
+                leaf, self._leaf_sharding(leaf, kp)
+            ),
             state,
         )
 
@@ -131,9 +138,10 @@ class FSDP:
         lines: list[str] = []
 
         def visit(kp, leaf):
-            path = keystr(kp)
-            spec = self.spec_for(tuple(leaf.shape))
-            lines.append(f"{path}: {tuple(leaf.shape)} -> {tuple(spec)}")
+            spec = self._leaf_sharding(leaf, kp).spec
+            lines.append(
+                f"{keystr(kp)}: {tuple(leaf.shape)} -> {tuple(spec)}"
+            )
 
         jax.tree_util.tree_map_with_path(visit, params)
         return lines
@@ -195,35 +203,8 @@ class HybridFSDP(FSDP):
             parts[best] = self.axis
         return NamedSharding(self.mesh, PartitionSpec(*parts))
 
-    def variable_shardings(self, abstract_variables):
-        return jax.tree_util.tree_map_with_path(
-            lambda kp, leaf: self._leaf_sharding(leaf, kp),
-            abstract_variables,
-        )
-
-    def shard_state(self, state):
-        return jax.tree_util.tree_map_with_path(
-            lambda kp, leaf: jax.device_put(
-                leaf, self._leaf_sharding(leaf, kp)
-            ),
-            state,
-        )
-
     def spec_for(self, shape):  # shape-only: ambiguous for 2D layouts
         raise NotImplementedError(
             "HybridFSDP placements depend on the param path, not shape "
             "alone — use variable_shardings/audit"
         )
-
-    def audit(self, params) -> list[str]:
-        """Path -> spec lines reflecting the actual 2D placement."""
-        lines: list[str] = []
-
-        def visit(kp, leaf):
-            spec = self._leaf_sharding(leaf, kp).spec
-            lines.append(
-                f"{keystr(kp)}: {tuple(leaf.shape)} -> {tuple(spec)}"
-            )
-
-        jax.tree_util.tree_map_with_path(visit, params)
-        return lines

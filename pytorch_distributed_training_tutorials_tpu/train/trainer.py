@@ -141,6 +141,22 @@ def _make_loss_fn(
     return loss_fn
 
 
+def _apply_update(state: TrainState, grads, loss_val, new_stats, has_batch_stats):
+    """The optimizer-update tail shared by the plain and gradient-
+    accumulation steps — one place owns tx.update/apply/replace/metrics."""
+    updates, new_opt_state = state.tx.update(
+        grads, state.opt_state, state.params
+    )
+    new_params = optax.apply_updates(state.params, updates)
+    new_state = state.replace(
+        step=state.step + 1,
+        params=new_params,
+        opt_state=new_opt_state,
+        batch_stats=new_stats if has_batch_stats else state.batch_stats,
+    )
+    return new_state, {"loss": loss_val}
+
+
 def _train_step_fn(
     loss: str = "cross_entropy",
     has_batch_stats: bool = False,
@@ -155,17 +171,9 @@ def _train_step_fn(
         (loss_val, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state, batch)
-        updates, new_opt_state = state.tx.update(
-            grads, state.opt_state, state.params
+        return _apply_update(
+            state, grads, loss_val, new_stats, has_batch_stats
         )
-        new_params = optax.apply_updates(state.params, updates)
-        new_state = state.replace(
-            step=state.step + 1,
-            params=new_params,
-            opt_state=new_opt_state,
-            batch_stats=new_stats if has_batch_stats else state.batch_stats,
-        )
-        return new_state, {"loss": loss_val}
 
     return step_fn
 
@@ -205,6 +213,12 @@ def make_train_step(
 
     def step_fn(state: TrainState, batch):
         n = grad_accum_steps
+        b = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        if b % n:
+            raise ValueError(
+                f"batch dim 0 ({b}) not divisible by "
+                f"grad_accum_steps ({n})"
+            )
         # strided split (microbatch m = rows m::n): with dim 0 sharded over
         # `data` in contiguous per-device blocks, every microbatch stays
         # evenly spread over all devices (a contiguous (n, B/n) reshape
@@ -240,23 +254,18 @@ def make_train_step(
         )
         inv = 1.0 / n
         grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
-        updates, new_opt_state = state.tx.update(
-            grads, state.opt_state, state.params
-        )
-        new_params = optax.apply_updates(state.params, updates)
-        new_state = state.replace(
-            step=state.step + 1,
-            params=new_params,
-            opt_state=new_opt_state,
-            batch_stats=jax.tree_util.tree_map(
+        new_stats = (
+            jax.tree_util.tree_map(
                 lambda s, old: (s * inv).astype(old.dtype),
                 s_sum,
                 state.batch_stats,
             )
             if has_batch_stats
-            else state.batch_stats,
+            else None
         )
-        return new_state, {"loss": l_sum * inv}
+        return _apply_update(
+            state, grads, l_sum * inv, new_stats, has_batch_stats
+        )
 
     return jax.jit(step_fn, donate_argnums=0)
 
@@ -369,6 +378,7 @@ class Trainer:
         strategy=None,  # DataParallel | TensorParallel | compatible
         loss: str = "cross_entropy",
         aux_loss_weight: float = 0.0,
+        grad_accum_steps: int = 1,
         seed: int = 0,
         log_every: int | None = None,
     ):
@@ -390,7 +400,16 @@ class Trainer:
             loss=loss,
             has_batch_stats=self.has_batch_stats,
             aux_loss_weight=aux_loss_weight,
+            grad_accum_steps=grad_accum_steps,
         )
+        if grad_accum_steps > 1 and getattr(
+            train_loader, "device_arrays", None
+        ) is not None:
+            raise ValueError(
+                "grad_accum_steps applies to the per-step path; the "
+                "device-resident epoch scan already amortizes memory — use "
+                "a streaming ShardedLoader for gradient accumulation"
+            )
         self.log_every = log_every
         self.loss_name = loss
         self.aux_loss_weight = aux_loss_weight

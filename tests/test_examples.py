@@ -1,0 +1,38 @@
+"""Example scripts are runnable user surface — smoke them as subprocesses.
+
+(The ResNet example is exercised on real TPU only: XLA:CPU compiles its
+28x28 convolutions for minutes, which the LLM example doesn't suffer.)
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "TPU_WORKER_HOSTNAMES")}
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"]
+    )
+    return subprocess.run(
+        [sys.executable, *args], env=env, capture_output=True, text=True,
+        timeout=900, cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["pp", "tp_sp"])
+def test_llm_example_runs(mode):
+    out = _run([
+        "examples/train_llm_3d.py", "--mode", mode, "--max_epochs", "1",
+    ])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "epoch 0: loss" in out.stdout

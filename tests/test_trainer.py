@@ -227,3 +227,19 @@ def test_grad_accum_with_batch_stats_runs():
     state, m = step(state, (dp.shard_batch(x), dp.shard_batch(y)))
     assert np.isfinite(float(m["loss"]))
     assert int(state.step) == 1
+
+
+def test_trainer_grad_accum_param():
+    """grad_accum_steps flows through the Trainer's documented surface."""
+    import optax
+    from helpers import make_cls_dataset
+
+    mesh = create_mesh({"data": 8})
+    loader = ShardedLoader(make_cls_dataset(n=256), 8, mesh)
+    trainer = Trainer(
+        MLP(features=(32, 4)), loader, optax.adam(1e-3),
+        loss="cross_entropy", grad_accum_steps=2,
+    )
+    first = trainer._run_epoch(0)
+    last = trainer.train(3)
+    assert last["loss"] < first["loss"]
