@@ -56,6 +56,13 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # int8 serving: every matmul weight becomes an Int8Dense(General) over
+    # the Pallas MXU kernel (the load_in_8bit twin, SURVEY C13). Params come
+    # from quantize_lm_params(f32_params); training is not supported.
+    # Placement: single-device or data-parallel replicated — TP-sharding a
+    # Pallas call needs an explicit shard_map wrapper (TP_RULES match
+    # 'kernel' params, not the int8 'q'/'scale' layout); future work.
+    quantized: bool = False
 
     @property
     def ff_dim(self) -> int:
@@ -133,9 +140,25 @@ class Attention(nn.Module):
     def __call__(self, x, decode: bool = False):
         cfg = self.cfg
         h, d = cfg.n_heads, cfg.head_dim
-        proj = lambda name: nn.DenseGeneral(  # noqa: E731
-            (h, d), axis=-1, use_bias=False, dtype=cfg.dtype, name=name
-        )
+        if cfg.quantized:
+            from pytorch_distributed_training_tutorials_tpu.ops.quant import (
+                Int8DenseGeneral,
+            )
+
+            proj = lambda name: Int8DenseGeneral(  # noqa: E731
+                (h, d), axis=-1, use_bias=False, name=name
+            )
+            out_proj = Int8DenseGeneral(
+                cfg.d_model, axis=(-2, -1), use_bias=False, name="o_proj"
+            )
+        else:
+            proj = lambda name: nn.DenseGeneral(  # noqa: E731
+                (h, d), axis=-1, use_bias=False, dtype=cfg.dtype, name=name
+            )
+            out_proj = nn.DenseGeneral(
+                cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+                name="o_proj",
+            )
         q_raw = proj("q_proj")(x)
         k_raw = proj("k_proj")(x)
         v = proj("v_proj")(x)
@@ -191,10 +214,7 @@ class Attention(nn.Module):
                 else causal_attention
             )
             out = attn(q, k, v)
-        return nn.DenseGeneral(
-            cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
-            name="o_proj",
-        )(out)
+        return out_proj(out)
 
 
 class SwiGLU(nn.Module):
@@ -203,9 +223,16 @@ class SwiGLU(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dense = lambda f, name: nn.Dense(  # noqa: E731
-            f, use_bias=False, dtype=cfg.dtype, name=name
-        )
+        if cfg.quantized:
+            from pytorch_distributed_training_tutorials_tpu.ops.quant import Int8Dense
+
+            dense = lambda f, name: Int8Dense(  # noqa: E731
+                f, use_bias=False, name=name
+            )
+        else:
+            dense = lambda f, name: nn.Dense(  # noqa: E731
+                f, use_bias=False, dtype=cfg.dtype, name=name
+            )
         gate = nn.silu(dense(cfg.ff_dim, "gate_proj")(x))
         up = dense(cfg.ff_dim, "up_proj")(x)
         return dense(cfg.d_model, "down_proj")(gate * up)
@@ -253,6 +280,11 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, decode: bool = False):
         cfg = self.cfg
+        if cfg.quantized and (cfg.scan_layers or cfg.moe_experts):
+            raise ValueError(
+                "quantized serving supports unrolled dense blocks only "
+                "(no scan_layers, no MoE)"
+            )
         if tokens.shape[1] > cfg.max_seq_len:
             raise ValueError(
                 f"sequence length {tokens.shape[1]} exceeds "
@@ -284,6 +316,12 @@ class TransformerLM(nn.Module):
             for i in range(cfg.n_layers):
                 x = block_cls(cfg, name=f"block_{i}")(x, decode)
         x = RMSNorm(name="final_norm")(x)
+        if cfg.quantized:
+            from pytorch_distributed_training_tutorials_tpu.ops.quant import Int8Dense
+
+            return Int8Dense(
+                cfg.vocab_size, use_bias=False, name="lm_head"
+            )(x)
         return nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
         )(x)
@@ -308,3 +346,118 @@ TP_RULES: list[tuple[str, P]] = [
 def ep_rules() -> list[tuple[str, P]]:
     """TP + expert-parallel rules for an MoE transformer (dp x tp x ep)."""
     return MOE_RULES + TP_RULES
+
+
+# the matmul weights int8 serving replaces (embeddings + norms stay float —
+# the exact mixed layout the reference's cell-4 param audit shows)
+_QUANTIZED_KERNELS = frozenset(
+    {
+        "q_proj", "k_proj", "v_proj", "o_proj",
+        "gate_proj", "up_proj", "down_proj", "lm_head",
+    }
+)
+
+
+def quantize_lm_params(params):
+    """Convert trained f32 :class:`TransformerLM` params into the
+    ``quantized=True`` serving layout: every matmul ``kernel`` becomes
+    ``{'q': int8, 'scale': f32 per-column}`` (DenseGeneral kernels
+    flattened 2-D), norms/embeddings untouched.
+
+    The ``from_pretrained(load_in_8bit=True)`` conversion step, done
+    explicitly: pairs with :func:`..parallel.auto.load_quantized` (which
+    streams + quantizes a checkpoint leaf-by-leaf) when the checkpoint is
+    on disk, or runs directly on in-memory params. Unrolled layers only
+    (``scan_layers=False`` — a leading layer axis would need per-layer
+    scales).
+    """
+    from pytorch_distributed_training_tutorials_tpu.ops.quant import quantize_int8
+
+    from collections.abc import Mapping
+
+    def walk(tree):
+        out = {}
+        for name, sub in tree.items():
+            if (
+                name in _QUANTIZED_KERNELS
+                and isinstance(sub, Mapping)  # dict or flax FrozenDict
+                and "kernel" in sub
+            ):
+                out[name] = {
+                    **_quantize_kernel(name, sub["kernel"], quantize_int8),
+                    **{k: v for k, v in sub.items() if k != "kernel"},
+                }
+            elif isinstance(sub, Mapping):
+                out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    return walk(dict(params))
+
+
+def _quantize_kernel(name: str, kernel, quantize_int8) -> dict:
+    """One matmul kernel -> {'q', 'scale'} in the serving layout (2-D
+    flattened the way Int8DenseGeneral stores it).
+
+    The input/output axis split is keyed by the TransformerLM layer name:
+    ``o_proj`` is the one axis=(-2, -1) projection ((H, D, d_model) ->
+    inputs are the leading axes); everything else contracts its first axis.
+    Adding a new name to ``_QUANTIZED_KERNELS`` requires deciding its split
+    here — an unknown name is NOT quantized (it passes through as float),
+    so a mistake fails loud (missing 'q' param), never silently wrong.
+    """
+    kern = jnp.asarray(kernel)
+    if kern.ndim < 2:
+        raise ValueError(f"{name}: kernel rank {kern.ndim} < 2")
+    if name == "o_proj":
+        k2 = kern.reshape(-1, kern.shape[-1])  # (H*D, d_model)
+    else:
+        k2 = kern.reshape(kern.shape[0], -1)  # (in, out...)
+    qp = quantize_int8(k2)
+    return {"q": qp.q, "scale": qp.scale.reshape(1, -1)}
+
+
+def load_quantized_lm(path):
+    """Stream a trained f32 :class:`TransformerLM` checkpoint straight into
+    the ``quantized=True`` serving layout, one leaf at a time.
+
+    The full ``from_pretrained(..., load_in_8bit=True)`` loop (reference
+    ``03.model_parallel.ipynb`` cell 2, SURVEY C13) on the flagship model:
+    each kernel is restored (:func:`..parallel.auto.restore_leaf` — no other
+    IO), flattened, quantized, and freed before the next leaf is read, so
+    the f32 model is never resident on host. Serve with
+    ``TransformerLM(replace(cfg, quantized=True))`` and
+    :func:`..models.generate.generate`.
+    """
+    import orbax.checkpoint as ocp
+
+    from pytorch_distributed_training_tutorials_tpu.ops.quant import quantize_int8
+    from pytorch_distributed_training_tutorials_tpu.parallel.auto import (
+        checkpoint_leaf_metadata,
+        restore_leaf,
+    )
+
+    flat, _ = checkpoint_leaf_metadata(path)
+    out: dict = {}
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        for kp, meta in flat:
+            keys = [
+                str(getattr(k, "key", getattr(k, "idx", k))) for k in kp
+            ]
+            leaf = restore_leaf(path, kp, meta, checkpointer=ckptr)
+            node = out
+            for k in keys[:-1]:
+                node = node.setdefault(k, {})
+            if (
+                len(keys) >= 2
+                and keys[-1] == "kernel"
+                and keys[-2] in _QUANTIZED_KERNELS
+            ):
+                node.update(
+                    _quantize_kernel(keys[-2], leaf, quantize_int8)
+                )
+                del leaf  # free the f32 kernel before the next read
+            else:
+                node[keys[-1]] = leaf
+    return out

@@ -208,6 +208,32 @@ def int8_matmul_reference(
     return acc * w.scale.reshape(1, -1)
 
 
+def _int8_affine(mod: nn.Module, x, feats: tuple, n_in: int, use_bias: bool):
+    """The shared body of the int8 serving layers: flattened 2-D ``q`` +
+    per-column ``scale`` params, the K-blocked MXU matmul, reshape, bias —
+    one copy for Int8Dense and Int8DenseGeneral."""
+    in_dims = x.shape[x.ndim - n_in :]
+    k = 1
+    for d in in_dims:
+        k *= d
+    n_out = 1
+    for f in feats:
+        n_out *= f
+    q = mod.param("q", nn.initializers.zeros, (k, n_out), jnp.int8)
+    scale = mod.param(
+        "scale", nn.initializers.ones, (1, n_out), jnp.float32
+    )
+    lead = x.shape[: x.ndim - n_in]
+    out = int8_matmul(
+        x.reshape(-1, k), Int8Param(q=q, scale=scale)
+    ).reshape(*lead, *feats)
+    if use_bias:
+        out = out + mod.param(
+            "bias", nn.initializers.zeros, feats, jnp.float32
+        )
+    return out.astype(x.dtype)
+
+
 class Int8Dense(nn.Module):
     """Serving twin of ``nn.Dense`` over int8 weights.
 
@@ -223,20 +249,31 @@ class Int8Dense(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        k = x.shape[-1]
-        q = self.param(
-            "q", nn.initializers.zeros, (k, self.features), jnp.int8
+        return _int8_affine(
+            self, x, (self.features,), 1, self.use_bias
         )
-        scale = self.param(
-            "scale", nn.initializers.ones, (1, self.features), jnp.float32
+
+
+class Int8DenseGeneral(nn.Module):
+    """Serving twin of ``nn.DenseGeneral`` over int8 weights.
+
+    Supports the two transformer shapes: ``axis=-1`` with tuple features
+    (the q/k/v projections, ``d_model -> (H, D)``) and ``axis=(-2, -1)``
+    (the o projection, ``(H, D) -> d_model``). The kernel is stored
+    flattened 2-D (``q``: (in, prod(features)) int8 + per-column scales) so
+    the K-blocked MXU kernel serves every case.
+    """
+
+    features: int | tuple[int, ...]
+    axis: int | tuple[int, ...] = -1
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        feats = (
+            self.features
+            if isinstance(self.features, tuple)
+            else (self.features,)
         )
-        lead = x.shape[:-1]
-        out = int8_matmul(
-            x.reshape(-1, k), Int8Param(q=q, scale=scale)
-        )
-        out = out.reshape(*lead, self.features)
-        if self.use_bias:
-            out = out + self.param(
-                "bias", nn.initializers.zeros, (self.features,), jnp.float32
-            )
-        return out.astype(x.dtype)
+        axes = self.axis if isinstance(self.axis, tuple) else (self.axis,)
+        return _int8_affine(self, x, feats, len(axes), self.use_bias)
