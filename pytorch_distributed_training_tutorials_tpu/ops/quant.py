@@ -135,7 +135,11 @@ def int8_matmul(
     # dim of x, sublane-int8 dim of q)
     block_m = min(block_m, max(8, m))
     block_m = -(-block_m // 8) * 8
-    block_n = min(block_n, n)
+    # N is the lane dim of the output/q blocks: round up to 128 like K (an
+    # odd-vocab lm_head must not hand the real-TPU kernel a sub-lane tile;
+    # pad_n below absorbs the rounding)
+    block_n = min(block_n, max(128, n))
+    block_n = -(-block_n // 128) * 128
     block_k = min(block_k, max(128, k))
     block_k = -(-block_k // 128) * 128
     pad_m = (-m) % block_m

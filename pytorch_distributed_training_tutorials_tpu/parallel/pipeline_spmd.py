@@ -190,6 +190,12 @@ class PipelinedTransformerLM:
         b = tokens.shape[0]
         if b % m:
             raise ValueError(f"batch {b} not divisible by {m} microbatches")
+        if tokens.shape[1] > cfg.max_seq_len:
+            # same validation contract as the unpipelined TransformerLM
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} exceeds "
+                f"max_seq_len {cfg.max_seq_len}"
+            )
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype).apply(
             {"params": params["tok_emb"]}, tokens
         )

@@ -199,7 +199,10 @@ def make_train_step(
     inside the compiled step (a ``lax.scan``), averaging gradients (and
     BatchNorm statistics) before ONE optimizer update — the standard trade
     of peak activation memory for step time when the global batch exceeds
-    HBM. Batch dim 0 must divide evenly.
+    HBM. Batch dim 0 must divide evenly; for the strided microbatch split to
+    stay evenly spread over a ``data``-sharded batch, the *per-device* row
+    count must also divide by ``grad_accum_steps`` (the Trainer validates
+    this where the mesh width is known).
     """
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
@@ -410,6 +413,29 @@ class Trainer:
                 "device-resident epoch scan already amortizes memory — use "
                 "a streaming ShardedLoader for gradient accumulation"
             )
+        if grad_accum_steps > 1:
+            if train_loader.global_batch % grad_accum_steps:
+                # the compiled step would reject this at trace time anyway
+                # (make_train_step's batch-dim check) — fail at construction
+                raise ValueError(
+                    f"global batch ({train_loader.global_batch}) not "
+                    f"divisible by grad_accum_steps ({grad_accum_steps})"
+                )
+            d = self.strategy.num_devices
+            per_dev = train_loader.global_batch // max(d, 1)
+            if per_dev % grad_accum_steps:
+                # semantically correct either way (microbatches are the same
+                # rows), but each scan iteration pays a reshard of its
+                # microbatch across the data axis — warn, don't break
+                import warnings
+
+                warnings.warn(
+                    f"per-device batch ({per_dev}) not divisible by "
+                    f"grad_accum_steps ({grad_accum_steps}): microbatches "
+                    "cannot stay evenly spread over the data axis and will "
+                    "reshard every accumulation step (slow, not wrong)",
+                    stacklevel=2,
+                )
         self.log_every = log_every
         self.loss_name = loss
         self.aux_loss_weight = aux_loss_weight
@@ -511,6 +537,7 @@ class Trainer:
             dt,
         )
         m["steps"] = steps  # per-epoch steps, like the per-epoch path
+        self.last_epoch_metrics = m  # keep the train()-path contract
         return m
 
     def _run_epoch(self, epoch: int) -> dict:
@@ -612,6 +639,11 @@ class Trainer:
         (:meth:`..data.loader.ShardedLoader.valid_mask`), so metrics are
         unbiased on datasets that don't divide evenly — unlike the
         reference, whose DistributedSampler silently double-counts the pad.
+
+        The returned ``"samples"`` counts *label positions*: for sequence
+        targets (an LM's (B, T) labels) that is rows x tokens, not rows.
+        Per-batch sums are float32 on device (exact up to 2^24 labels per
+        batch); the cross-batch accumulation happens on host in float64.
         """
         import numpy as np
 
