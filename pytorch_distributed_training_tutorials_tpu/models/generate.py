@@ -5,11 +5,14 @@ generates a single token (``/root/reference/03.model_parallel.ipynb`` cell 0
 imports it, no ``generate`` call anywhere — SURVEY.md section 5.7). This
 module completes the serving story TPU-natively:
 
-- each :class:`..models.transformer.Attention` keeps ``cached_key`` /
-  ``cached_value`` variables (the 'cache' collection) and appends one
-  position per step — O(S) per token instead of O(S^2) re-forwarding;
-- the whole prefill + decode loop is ONE jitted ``lax.scan`` over token
-  positions: no data-dependent Python control flow, static shapes
+- the prompt is prefilled in ONE batched forward (``prefill=True``) that
+  populates each :class:`..models.transformer.Attention`'s ``cached_key`` /
+  ``cached_value`` variables for positions ``[0, P)`` — launch count is
+  independent of prompt length (a P-step one-token prefill would pay P
+  dispatches, each attending over the whole ``max_seq_len`` cache);
+- decode then appends one position per step — O(S) per token instead of
+  O(S^2) re-forwarding — as a single jitted ``lax.scan`` over the *new*
+  tokens only: no data-dependent Python control flow, static shapes
   (``max_seq_len`` cache, fixed step count), the XLA-friendly shape. The
   compiled program is cached per ``(model, prompt_len, total_len,
   temperature)``, so repeated calls don't retrace;
@@ -29,54 +32,51 @@ import jax.numpy as jnp
 
 
 @functools.lru_cache(maxsize=64)
-def _cache_shapes(model, b: int):
-    """Abstract cache pytree for batch ``b`` — eval_shape traces the
-    decode-path init without materializing params; cached so repeated
-    generate() calls pay no per-call tracing."""
-    return jax.eval_shape(
-        functools.partial(model.init, decode=True),
-        jax.random.PRNGKey(0),
-        jnp.zeros((b, 1), jnp.int32),
-    )["cache"]
-
-
-@functools.lru_cache(maxsize=64)
 def _compiled_generate(model, p_len: int, total: int, temperature: float):
-    """Jitted prefill+decode scan for fixed lengths (flax modules hash by
-    structure, so this caches across calls with the same config)."""
+    """Jitted batched-prefill + decode scan for fixed lengths (flax modules
+    hash by structure, so this caches across calls with the same config)."""
+
+    def sample(logits, key):
+        if temperature > 0:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        return nxt.astype(jnp.int32), key
 
     @jax.jit
-    def run(params, cache, tokens, key):
+    def run(params, tokens, key):
+        b = tokens.shape[0]
+        # ONE forward over the whole prompt: last-position logits (prefill
+        # skips the discarded lm_head rows) + a cache holding K/V [0, p_len)
+        logits, upd = model.apply(
+            {"params": params},
+            tokens[:, :p_len],
+            prefill=True,
+            mutable=["cache"],
+        )
+        cache = upd["cache"]
+        nxt, key = sample(logits[:, -1].astype(jnp.float32), key)
+        tokens = jax.lax.dynamic_update_slice(tokens, nxt[:, None], (0, p_len))
+
         def step(carry, t):
             cache, tokens, key = carry
-            b = tokens.shape[0]
             tok = jax.lax.dynamic_slice(tokens, (0, t), (b, 1))
-            logits, upd = model.apply(
+            lg, upd = model.apply(
                 {"params": params, "cache": cache},
                 tok,
                 decode=True,
                 mutable=["cache"],
             )
-            logits = logits[:, -1].astype(jnp.float32)  # (B, vocab)
-            if temperature > 0:
-                k2, sub = jax.random.split(key)
-                nxt = jax.random.categorical(
-                    sub, logits / temperature, axis=-1
-                )
-            else:
-                k2 = key
-                nxt = jnp.argmax(logits, axis=-1)
-            write_pos = t + 1  # in [1, total-1]: always in bounds
-            keep_prompt = write_pos < p_len
-            cur = jax.lax.dynamic_slice(tokens, (0, write_pos), (b, 1))[:, 0]
-            nxt = jnp.where(keep_prompt, cur, nxt.astype(jnp.int32))
+            nxt, key2 = sample(lg[:, -1].astype(jnp.float32), key)
             tokens = jax.lax.dynamic_update_slice(
-                tokens, nxt[:, None], (0, write_pos)
+                tokens, nxt[:, None], (0, t + 1)
             )
-            return (upd["cache"], tokens, k2), None
+            return (upd["cache"], tokens, key2), None
 
-        (cache, tokens, _), _ = jax.lax.scan(
-            step, (cache, tokens, key), jnp.arange(total - 1)
+        # zero-length when max_new_tokens == 1: scan returns the carry as-is
+        (_, tokens, _), _ = jax.lax.scan(
+            step, (cache, tokens, key), jnp.arange(p_len, total - 1)
         )
         return tokens
 
@@ -95,12 +95,12 @@ def generate(
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
     ``model`` is a :class:`..models.transformer.TransformerLM` (or anything
-    with the same ``apply(variables, tokens, decode=True, mutable=['cache'])``
-    contract AND a ``.cfg.max_seq_len`` attribute bounding the cache);
-    ``prompt``: int32 ``(B, P)`` with ``P >= 1``. Returns int32
-    ``(B, P + max_new_tokens)``. The prompt is prefilled through the same
-    one-token decode path the generation loop uses (simple and cache-exact;
-    a batched prefill is a natural later optimization).
+    with the same ``apply(variables, tokens, prefill=True / decode=True,
+    mutable=['cache'])`` contract AND a ``.cfg.max_seq_len`` attribute
+    bounding the cache); ``prompt``: int32 ``(B, P)`` with ``P >= 1``.
+    Returns int32 ``(B, P + max_new_tokens)``. The prompt is prefilled in
+    one batched forward; only the new tokens run through the sequential
+    decode scan.
 
     Greedy when ``temperature == 0`` (the default), otherwise softmax
     sampling at the given temperature using ``rng``.
@@ -123,12 +123,8 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    cache = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), _cache_shapes(model, b)
-    )
-
     tokens0 = jnp.concatenate(
         [prompt, jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1
     )
     run = _compiled_generate(model, p_len, total, float(temperature))
-    return run(params, cache, tokens0, rng)
+    return run(params, tokens0, rng)

@@ -136,9 +136,30 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
 class Attention(nn.Module):
     cfg: TransformerConfig
 
-    @nn.compact
-    def __call__(self, x, decode: bool = False):
+    def _cache_vars(self, b: int, k_dtype, v_dtype):
+        """The one copy of the KV-cache schema shared by the decode and
+        prefill branches (shapes/dtypes must agree or decode misreads what
+        prefill wrote)."""
         cfg = self.cfg
+        h, d = cfg.n_heads, cfg.head_dim
+        cached_k = self.variable(
+            "cache", "cached_key",
+            jnp.zeros, (b, cfg.max_seq_len, h, d), k_dtype,
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            jnp.zeros, (b, cfg.max_seq_len, h, d), v_dtype,
+        )
+        idx = self.variable(
+            "cache", "cache_index",
+            lambda: jnp.zeros((), jnp.int32),
+        )
+        return cached_k, cached_v, idx
+
+    @nn.compact
+    def __call__(self, x, decode: bool = False, prefill: bool = False):
+        cfg = self.cfg
+        assert not (decode and prefill), "decode and prefill are exclusive"
         h, d = cfg.n_heads, cfg.head_dim
         if cfg.quantized:
             from pytorch_distributed_training_tutorials_tpu.ops.quant import (
@@ -176,17 +197,8 @@ class Attention(nn.Module):
             # would need its own decode rule.
             b = x.shape[0]
             assert x.shape[1] == 1, "decode=True expects one token at a time"
-            cached_k = self.variable(
-                "cache", "cached_key",
-                jnp.zeros, (b, cfg.max_seq_len, h, d), k_raw.dtype,
-            )
-            cached_v = self.variable(
-                "cache", "cached_value",
-                jnp.zeros, (b, cfg.max_seq_len, h, d), v.dtype,
-            )
-            idx = self.variable(
-                "cache", "cache_index",
-                lambda: jnp.zeros((), jnp.int32),
+            cached_k, cached_v, idx = self._cache_vars(
+                b, k_raw.dtype, v.dtype
             )
             pos = idx.value
             q = apply_rope(q_raw, cfg.rope_theta, offset=pos)
@@ -208,6 +220,24 @@ class Attention(nn.Module):
         else:
             q = apply_rope(q_raw, cfg.rope_theta)
             k = apply_rope(k_raw, cfg.rope_theta)
+            if prefill:
+                # batched prefill: the same causal forward as training, but
+                # it also populates cache positions [0, S) and sets
+                # cache_index = S, so decode=True steps continue from the
+                # prompt in O(1) launches instead of O(P) one-token passes
+                # (generate() drives this; the one-token path self-documents
+                # the contract)
+                b, s = x.shape[0], x.shape[1]
+                cached_k, cached_v, idx = self._cache_vars(
+                    b, k_raw.dtype, v.dtype
+                )
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k, (0, 0, 0, 0)
+                )
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v, (0, 0, 0, 0)
+                )
+                idx.value = jnp.asarray(s, jnp.int32)
             attn = (
                 cfg.attention_fn
                 if cfg.attention_fn is not None
@@ -242,10 +272,10 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, decode: bool = False):
+    def __call__(self, x, decode: bool = False, prefill: bool = False):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(name="attn_norm")(x), decode=decode
+            RMSNorm(name="attn_norm")(x), decode=decode, prefill=prefill
         )
         if cfg.moe_experts > 0:
             ffn = MoEFFN(
@@ -266,10 +296,13 @@ class _ScanCell(nn.Module):
 
     cfg: TransformerConfig
     decode: bool = False
+    prefill: bool = False
 
     @nn.compact
     def __call__(self, x, _):
-        return Block(self.cfg, name="block")(x, decode=self.decode), None
+        return Block(self.cfg, name="block")(
+            x, decode=self.decode, prefill=self.prefill
+        ), None
 
 
 class TransformerLM(nn.Module):
@@ -278,7 +311,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, decode: bool = False):
+    def __call__(self, tokens, decode: bool = False, prefill: bool = False):
         cfg = self.cfg
         if cfg.quantized and (cfg.scan_layers or cfg.moe_experts):
             raise ValueError(
@@ -305,16 +338,23 @@ class TransformerLM(nn.Module):
                 variable_axes={"params": 0, "losses": 0, "cache": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
-            )(cfg, decode, name="layers")
+            )(cfg, decode, prefill, name="layers")
             x, _ = stack(x, None)
         else:
-            # decode is a Python bool steering cache behavior — it must stay
-            # static under remat (arg 2 of __call__ counting self)
+            # decode/prefill are Python bools steering cache behavior — they
+            # must stay static under remat (args 2/3 of __call__ incl. self)
             block_cls = (
-                nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
+                nn.remat(Block, static_argnums=(2, 3))
+                if cfg.remat
+                else Block
             )
             for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f"block_{i}")(x, decode)
+                x = block_cls(cfg, name=f"block_{i}")(x, decode, prefill)
+        if prefill:
+            # only the last position's logits feed the next-token sample;
+            # skip the (P-1) discarded lm_head rows — at serving widths the
+            # head is the single largest matmul in the prefill
+            x = x[:, -1:]
         x = RMSNorm(name="final_norm")(x)
         if cfg.quantized:
             from pytorch_distributed_training_tutorials_tpu.ops.quant import Int8Dense

@@ -9,7 +9,9 @@ device folds the incoming block into its queries' attention state with the
 numerically-stable online-softmax update (running max ``m``, normalizer
 ``l``, unnormalized accumulator ``o`` — the blockwise/flash decomposition).
 Peak memory per device is O(S/n * S/n) scores instead of O(S^2): sequence
-length scales linearly with the ring size.
+length scales linearly with the ring size. The bound holds through
+**backward** too: each hop is ``jax.checkpoint``-ed (see :func:`_ring_hop`),
+so ``jax.grad`` re-derives score blocks instead of storing one per hop.
 
 The ring is unrolled (ring size is a static mesh property), so XLA can
 overlap each step's ppermute with the previous step's matmuls — communication
@@ -54,6 +56,41 @@ def _qkv_spec(mesh: Mesh, data_axis: str, seq_axis: str, model_axis: str) -> P:
     )
 
 
+@jax.checkpoint
+def _ring_hop(qb, k_t, v_t, o, l, m, q_pos, k_pos, scale):
+    """One ring hop: fold an incoming K/V block into the online-softmax
+    state ``(o, l, m)``.
+
+    ``jax.checkpoint`` here is what makes the module's O((S/n)^2) memory
+    claim true *through backward*: without it, ``jax.grad`` over the
+    unrolled ring stores every hop's (b, h, s_blk, s_blk) probability
+    block — n of them, i.e. O(S^2/n) per device, roughly the thing the
+    ring exists to avoid. Rematerialized, backward re-derives each hop's
+    scores/probabilities from its O(s_blk * d) inputs, so only one score
+    block is ever live (``tests/test_ring_attention.py`` pins the residual
+    footprint vs dense attention).
+    """
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", qb, k_t, preferred_element_type=jnp.float32
+    ) * scale
+    causal = q_pos[:, None] >= k_pos[None, :]  # (s_blk, s_blk) global
+    scores = jnp.where(causal[None, None], scores, NEG_INF)
+
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # m_new is finite from t=0 on: src==idx at t=0, so every query row sees
+    # its own diagonal key first. (If the rotation start is ever changed,
+    # -inf rows would need exp-of-nan guards here.)
+    # (at t=0, corr = exp(-inf - finite) = 0 exactly, zeroing the empty
+    # initial accumulators — no NaN guard needed)
+    p = jnp.exp(scores - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1)
+    o = o * corr[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_t.astype(jnp.float32)
+    )
+    return o, l, m_new
+
+
 def make_ring_attention(
     mesh: Mesh,
     *,
@@ -96,25 +133,9 @@ def make_ring_attention(
             # after t hops I hold the block that started on device (idx - t)
             src = (idx - t) % n
             k_pos = src * s_blk + jnp.arange(s_blk)
-            scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", qb, k_t, preferred_element_type=jnp.float32
-            ) * scale
-            causal = q_pos[:, None] >= k_pos[None, :]  # (s_blk, s_blk) global
-            scores = jnp.where(causal[None, None], scores, NEG_INF)
-
-            m_new = jnp.maximum(m, scores.max(axis=-1))
-            # m_new is finite from t=0 on: src==idx at t=0, so every query
-            # row sees its own diagonal key first. (If the rotation start is
-            # ever changed, -inf rows would need exp-of-nan guards here.)
-            # (at t=0, corr = exp(-inf - finite) = 0 exactly, zeroing the
-            # empty initial accumulators — no NaN guard needed)
-            p = jnp.exp(scores - m_new[..., None])
-            corr = jnp.exp(m - m_new)
-            l = l * corr + p.sum(axis=-1)
-            o = o * corr[..., None] + jnp.einsum(
-                "bhqk,bkhd->bhqd", p, v_t.astype(jnp.float32)
+            o, l, m = _ring_hop(
+                qb, k_t, v_t, o, l, m, q_pos, k_pos, scale
             )
-            m = m_new
             if t < n - 1:
                 k_t, v_t = jax.lax.ppermute(
                     (k_t, v_t), seq_axis, perm=shift

@@ -87,6 +87,55 @@ def test_single_decode_step_logits_match_full_forward():
     )
 
 
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_batched_prefill_matches_stepwise_cache(scan_layers):
+    """One prefill=True forward must leave the cache exactly as P one-token
+    decode steps would (same K/V contents, same cache_index) and emit the
+    full forward's logits — the prefill is a batching of the decode path,
+    not a different model."""
+    model, params = _model(scan_layers=scan_layers)
+    rng = np.random.Generator(np.random.PCG64(3))
+    tokens = jnp.asarray(rng.integers(0, 32, (2, 6)), jnp.int32)
+
+    pre_logits, pre = model.apply(
+        {"params": params}, tokens, prefill=True, mutable=["cache"]
+    )
+    cache = jax.tree_util.tree_map(
+        jnp.zeros_like,
+        model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32), decode=True
+        )["cache"],
+    )
+    for t in range(6):
+        step_logits, upd = model.apply(
+            {"params": params, "cache": cache},
+            tokens[:, t : t + 1],
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = upd["cache"]
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5
+        ),
+        pre["cache"],
+        cache,
+    )
+    # prefill emits the LAST position's logits only (the next-token feed);
+    # they must equal the full training forward's final position
+    assert pre_logits.shape == (2, 1, 32)
+    full = model.apply({"params": params}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(pre_logits[:, 0]), np.asarray(full[:, -1]),
+        rtol=1e-6, atol=1e-6,
+    )
+    # ... and the stepwise decode path's logits at the same position
+    np.testing.assert_allclose(
+        np.asarray(pre_logits[:, -1]), np.asarray(step_logits[:, 0]),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
 def test_sampling_is_seeded_and_in_vocab():
     model, params = _model()
     prompt = jnp.zeros((2, 3), jnp.int32)

@@ -85,6 +85,43 @@ def test_transformer_logits_identical_with_ring():
     )
 
 
+def _residual_bytes(f, *args):
+    """Total bytes of the residuals jax.vjp stores for f's backward (the
+    arrays closed over by the returned vjp function)."""
+    _, vjp_fn = jax.vjp(f, *args)
+    return sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(vjp_fn)
+        if hasattr(x, "size") and hasattr(x, "dtype")
+    )
+
+
+def test_ring_backward_memory_is_blockwise():
+    """The docstring's O((S/n)^2) claim through backward: per-hop remat
+    means no per-hop probability blocks are saved as residuals.
+
+    Two pins: (a) residual growth in S is ~linear (an un-remat'd ring's
+    residuals are dominated by n blocks of (S/n)^2 probabilities = O(S^2/n),
+    growing 4x per S doubling); (b) per-device residuals undercut dense
+    attention's O(S^2) softmax weights by a wide margin.
+    """
+    mesh = create_mesh({"seq": 8})
+    ring = make_ring_attention(mesh)
+    sizes = {}
+    for s in (256, 512):
+        q, k, v = _qkv(b=2, s=s, h=2, d=16)
+        sizes[s] = _residual_bytes(ring, q, k, v)
+    growth = sizes[512] / sizes[256]
+    assert growth < 3.0, f"residuals grew {growth:.2f}x for 2x seq (quadratic?)"
+
+    q, k, v = _qkv(b=2, s=512, h=2, d=16)
+    dense_bytes = _residual_bytes(causal_attention, q, k, v)
+    # ring residuals (q/k/v blocks + o/l/m per hop) are seq-sharded: global
+    # bytes / ring size = per-device footprint; dense residuals (the (B, H,
+    # S, S) softmax weights) are whole on every device
+    assert sizes[512] / 8 < dense_bytes / 4, (sizes[512] // 8, dense_bytes)
+
+
 def test_sp_training_end_to_end():
     """Full SP training: tokens sharded (data, seq), ring attention inside
     the jitted train step, loss decreases."""
