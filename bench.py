@@ -47,6 +47,7 @@ def main() -> None:
     import optax
 
     from pytorch_distributed_training_tutorials_tpu.data import (
+        ChunkedStreamingLoader,
         DeviceResidentLoader,
         ShardedLoader,
         mnist,
@@ -80,30 +81,62 @@ def main() -> None:
 
     fused_epochs = 3
     with contextlib.redirect_stdout(sys.stderr):
-        # Epoch 0 compiles the per-epoch program; the first fused call
-        # compiles the fused-run program (different scan length); the second
-        # fused call is the honest end-to-end measurement: dataset residency,
-        # on-device gather, train step, ONE launch + ONE host fetch for the
-        # whole region (profile finding: per-epoch launch/fetch overhead was
-        # ~8% of epoch wall time on the tunneled runtime).
+        # TIMING DISCIPLINE on the tunneled runtime (measured, round 3):
+        # before the process's first D2H fetch, `block_until_ready` and
+        # device_put report async mirages (an apparent 778k img/s streamed
+        # epoch whose device trace shows ~7 s of real execution); the first
+        # fetch stalls ~19 s and drops apparent H2D to the tunnel's TRUE
+        # sustained bandwidth (~4-16 MB/s). Honest numbers therefore need
+        # (a) the first fetch PRIMED outside any timed region and (b) every
+        # timed region closed by a real fetch — which the legs below do.
+
+        # Breakdown leg 1a: streaming END-TO-END TRAINING — the path a
+        # larger-than-HBM dataset actually takes: chunked H2D (16 steps per
+        # transfer), background prefetch, each chunk trained as one scanned
+        # launch (data/streaming.py). Ceiling on this host: the tunnel's
+        # true H2D bandwidth (~8-16 MB/s ≈ 10-20k img/s of uint8 MNIST);
+        # on real PCIe hosts the step rate (~36 MB/s needed) is the bound.
+        chunked = ChunkedStreamingLoader(
+            ds, per_device_batch, mesh, seed=0,
+            steps_per_chunk=16, transform=loader.transform,
+        )
+        stream_trainer = Trainer(
+            model, chunked, optax.sgd(0.05, momentum=0.9),
+            loss="cross_entropy",
+        )
+        # compiles both chunk lengths AND primes the first-fetch stall
+        # (the per-epoch loss fetch) outside the timed region
+        stream_trainer._run_epoch(0)
+        stream_train_images_s = stream_trainer._run_epoch(1)[
+            "samples_per_sec"
+        ]
+
+        # Breakdown leg 1b: the input pipeline alone (native C++ row gather
+        # + chunked H2D + prefetch), no compute — one full pass, closed by
+        # a real fetch of the last chunk's bytes
+        t0 = time.perf_counter()
+        n_steps = 0
+        for chunk in chunked.iter_chunks():
+            jax.block_until_ready(chunk)
+            n_steps += chunk[0].shape[0]
+        float(chunk[1][-1, -1])  # terminal fetch: close the async pipeline
+        input_images_s = n_steps * chunked.global_batch / (
+            time.perf_counter() - t0
+        )
+        streaming = ShardedLoader(ds, per_device_batch, mesh, seed=0)
+
+        # Headline: epoch 0 compiles the per-epoch program; the first fused
+        # call compiles the fused-run program (different scan length); the
+        # second fused call is the honest end-to-end measurement: dataset
+        # residency, on-device gather, train step, ONE launch + ONE host
+        # fetch for the whole region (profile finding: per-epoch
+        # launch/fetch overhead was ~8% of epoch wall time on the tunneled
+        # runtime).
         trainer._run_epoch(0)
         trainer.run_epochs_fused(1, fused_epochs)  # compile warmup
         e2e = trainer.run_epochs_fused(1 + fused_epochs, fused_epochs)[
             "samples_per_sec"
         ]
-
-        # Breakdown leg 1: the *streaming* input pipeline (native C++ row
-        # gather + per-batch H2D), one full pass, no compute — what a
-        # larger-than-HBM dataset would pay on the host side.
-        streaming = ShardedLoader(ds, per_device_batch, mesh, seed=0)
-        t0 = time.perf_counter()
-        n_batches = 0
-        for batch in streaming:
-            jax.block_until_ready(batch)
-            n_batches += 1
-        input_images_s = n_batches * streaming.global_batch / (
-            time.perf_counter() - t0
-        )
 
         # Breakdown leg 2: train step alone on a cached batch — a jitted
         # scan of N chained steps, timed as one launch + one fetch. (Round 1
@@ -176,7 +209,14 @@ def main() -> None:
                     eval_metrics["accuracy"] >= 0.99
                 ),
                 "breakdown": {
-                    "input_pipeline_images_per_sec_per_chip": round(
+                    "streaming_train_images_per_sec_per_chip": round(
+                        stream_train_images_s / n_chips, 1
+                    ),
+                    # renamed from input_pipeline_... in round 3: this leg
+                    # now measures the CHUNKED+prefetched pipeline (the one
+                    # training actually uses), not round 2's per-batch
+                    # ShardedLoader H2D — not comparable across that change
+                    "chunked_input_pipeline_images_per_sec_per_chip": round(
                         input_images_s / n_chips, 1
                     ),
                     "train_step_only_images_per_sec_per_chip": round(

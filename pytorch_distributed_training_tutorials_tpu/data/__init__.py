@@ -26,3 +26,6 @@ from pytorch_distributed_training_tutorials_tpu.data.prefetch import (  # noqa: 
 from pytorch_distributed_training_tutorials_tpu.data.resident import (  # noqa: F401
     DeviceResidentLoader,
 )
+from pytorch_distributed_training_tutorials_tpu.data.streaming import (  # noqa: F401
+    ChunkedStreamingLoader,
+)

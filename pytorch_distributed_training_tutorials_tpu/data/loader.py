@@ -51,11 +51,20 @@ class ShardedLoader:
         seed: int = 0,
         drop_last: bool = False,
         batch_spec: PartitionSpec | None = None,
+        transform=None,
     ):
         if batch_mode not in ("per_device", "global"):
             raise ValueError(f"unknown batch_mode {batch_mode!r}")
         self.dataset = dataset
         self.mesh = mesh
+        # transform runs ON DEVICE, after the batch lands (or inside the
+        # compiled scan for the resident/chunked subclasses) — e.g. uint8
+        # images to normalized float. Jitted here so dtype semantics match
+        # the compiled paths exactly: numpy would promote
+        # `x.astype(bfloat16) / 255.0` to float32; JAX weak-typing keeps
+        # bfloat16 under jit.
+        self.transform = transform
+        self._jit_transform = jax.jit(transform) if transform else None
         self.axis = axis
         self.world = mesh.shape.get(axis, 1)
         if batch_mode == "global":
@@ -103,14 +112,31 @@ class ShardedLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
+    def _apply_transform(self, batch):
+        if self._jit_transform is None:
+            return batch
+        if isinstance(batch, tuple):
+            return self._jit_transform(*batch)
+        return self._jit_transform(batch)
+
     def sample_batch(self):
         """A representative (host) sample for model init — the loader-owned
         seam that keeps consumers (Trainer) out of the dataset's internals.
-        Returns full-length views (numpy slices are views, not copies), so
-        init-time consumers can slice whatever row count their mesh needs."""
+        Without a ``transform``, returns full-length views (numpy slices are
+        views, not copies) so init-time consumers can slice whatever row
+        count their mesh needs; with one, a batch-sized slice is transformed
+        first — init must see the shapes/dtypes training actually uses."""
         arrays = self.dataset.arrays
         sample = tuple(a[:] for a in arrays)
-        return sample if len(arrays) > 1 else sample[0]
+        if self._jit_transform is None:
+            return sample if len(arrays) > 1 else sample[0]
+        rows = min(len(self.dataset), self.global_batch)
+        sample = tuple(a[:rows] for a in sample)
+        # unwrap single-array datasets BEFORE transforming: the transform's
+        # return is its own (arbitrary) pytree, not indexable by convention
+        return self._apply_transform(
+            sample if len(arrays) > 1 else sample[0]
+        )
 
     def valid_mask(self, step: int) -> np.ndarray:
         """(global_batch,) bool mask, replica-major like the batch rows:
@@ -177,4 +203,8 @@ class ShardedLoader:
                 )
 
             batch = tuple(make(ai) for ai in range(n_arrays))
-            yield batch if n_arrays > 1 else batch[0]
+            # unwrap single-array datasets BEFORE transforming (the
+            # transform sees what the consumer sees)
+            yield self._apply_transform(
+                batch if n_arrays > 1 else batch[0]
+            )

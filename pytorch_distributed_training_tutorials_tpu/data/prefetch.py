@@ -1,4 +1,4 @@
-"""Prefetching loader: overlap host batch assembly/H2D with device compute.
+"""Prefetching: overlap host batch assembly/H2D with device compute.
 
 The reference's DataLoader gets this from worker processes + ``pin_memory``
 (``ddp_gpus.py:73-79``); the TPU twin is a single background thread that runs
@@ -15,6 +15,57 @@ import queue
 import threading
 
 _SENTINEL = object()
+
+
+def prefetch_iterable(iterable, depth: int = 2):
+    """Yield ``iterable``'s items, produced ``depth`` ahead in a background
+    thread. The generic engine under :class:`PrefetchLoader`, also used
+    directly for chunk streams (:class:`.streaming.ChunkedStreamingLoader`).
+
+    Exceptions in the producer re-raise in the consumer; abandoning the
+    generator stops the producer promptly.
+    """
+    if depth < 1:
+        raise ValueError("prefetch depth must be >= 1")
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    err: list[BaseException] = []
+    stop = threading.Event()
+
+    def put_or_stop(item) -> bool:
+        """Blocking put that aborts when the consumer bailed; returns
+        False on abort. The sentinel MUST go through here too — a
+        dropped sentinel leaves the consumer blocked forever."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in iterable:
+                if not put_or_stop(item):
+                    return
+        except BaseException as e:  # surfaced in the consumer
+            err.append(e)
+        finally:
+            put_or_stop(_SENTINEL)
+
+    t = threading.Thread(target=producer, daemon=True, name="prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                break
+            yield item
+        if err:
+            raise err[0]
+    finally:
+        stop.set()
+        t.join(timeout=10)
 
 
 class PrefetchLoader:
@@ -39,42 +90,4 @@ class PrefetchLoader:
 
     # --- iteration ---------------------------------------------------------
     def __iter__(self):
-        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
-        err: list[BaseException] = []
-        stop = threading.Event()
-
-        def put_or_stop(item) -> bool:
-            """Blocking put that aborts when the consumer bailed; returns
-            False on abort. The sentinel MUST go through here too — a
-            dropped sentinel leaves the consumer blocked forever."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def producer():
-            try:
-                for batch in self.loader:
-                    if not put_or_stop(batch):
-                        return
-            except BaseException as e:  # surfaced in the consumer
-                err.append(e)
-            finally:
-                put_or_stop(_SENTINEL)
-
-        t = threading.Thread(target=producer, daemon=True, name="prefetch")
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _SENTINEL:
-                    break
-                yield item
-            if err:
-                raise err[0]
-        finally:
-            stop.set()
-            t.join(timeout=10)
+        yield from prefetch_iterable(self.loader, self.prefetch)

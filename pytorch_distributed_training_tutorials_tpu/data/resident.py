@@ -62,13 +62,7 @@ class DeviceResidentLoader(ShardedLoader):
                 "use ShardedLoader for custom batch_specs (e.g. sequence "
                 "parallelism)"
             )
-        super().__init__(dataset, batch_size, mesh, **kwargs)
-        self.transform = transform
-        # Host-path twin of the in-scan transform, jitted so dtype semantics
-        # match the compiled epoch exactly: numpy would promote
-        # `x.astype(bfloat16) / 255.0` to float32 on host, while JAX
-        # weak-typing keeps bfloat16 under jit.
-        self._jit_transform = jax.jit(transform) if transform else None
+        super().__init__(dataset, batch_size, mesh, transform=transform, **kwargs)
         # Replicated residency: every device holds the dataset, so the
         # per-step gather is local (no collectives). Tutorial-scale datasets
         # are far smaller than HBM; shard-over-data residency is the natural
@@ -77,32 +71,6 @@ class DeviceResidentLoader(ShardedLoader):
         self.device_arrays = tuple(
             jax.device_put(a, rep) for a in dataset.arrays
         )
-
-    def _apply_transform(self, batch):
-        if self._jit_transform is None:
-            return batch
-        if isinstance(batch, tuple):
-            return self._jit_transform(*batch)
-        return self._jit_transform(batch)
-
-    def sample_batch(self):
-        """A batch-sized host sample with ``transform`` applied — model init
-        must see the shapes/dtypes the compiled epoch actually trains on.
-        Sliced *before* transforming so the whole dataset is never copied."""
-        sample = super().sample_batch()
-        rows = min(len(self.dataset), self.global_batch)
-        if isinstance(sample, tuple):
-            sample = tuple(a[:rows] for a in sample)
-        else:
-            sample = sample[:rows]
-        return self._apply_transform(sample)
-
-    def __iter__(self):
-        """Streaming iteration (parent semantics) with ``transform`` applied,
-        so iteration-based consumers (``Trainer.evaluate``, plain loops) see
-        the same data the compiled epoch scan trains on."""
-        for batch in super().__iter__():
-            yield self._apply_transform(batch)
 
     def epoch_index_array(self, epoch: int) -> jax.Array:
         """The epoch's ``(steps, global_batch)`` int32 index matrix, on

@@ -122,6 +122,6 @@ def moe_aux_loss(variables_or_updates) -> jax.Array:
 # the router is replicated. Merge with the transformer's TP_RULES for a
 # combined dp x tp x ep layout.
 MOE_RULES: list[tuple[str, P]] = [
-    (r".*/(w_gate|w_up|w_down)", P(EXPERT_AXIS, None, None)),
-    (r".*/router", P(None, None)),
+    (r"(^|/)(w_gate|w_up|w_down)$", P(EXPERT_AXIS, None, None)),
+    (r"(^|/)router$", P(None, None)),
 ]

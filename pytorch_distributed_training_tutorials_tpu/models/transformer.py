@@ -58,11 +58,16 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     # int8 serving: every matmul weight becomes an Int8Dense(General) over
     # the Pallas MXU kernel (the load_in_8bit twin, SURVEY C13). Params come
-    # from quantize_lm_params(f32_params); training is not supported.
-    # Placement: single-device or data-parallel replicated — TP-sharding a
-    # Pallas call needs an explicit shard_map wrapper (TP_RULES match
-    # 'kernel' params, not the int8 'q'/'scale' layout); future work.
+    # from quantize_lm_params(f32_params) or load_quantized_lm(path);
+    # training is not supported.
     quantized: bool = False
+    # Tensor-parallel int8 serving: a mesh with a 'model' axis routes every
+    # quantized matmul through the shard_map-wrapped kernel
+    # (ops.quant.int8_matmul_tp) in the Megatron column/row layout; q/scale
+    # params shard per INT8_TP_RULES. Requires n_heads, ff_dim, vocab_size
+    # and d_model divisible by the model-axis size. None = single-device /
+    # replicated serving.
+    int8_mesh: "jax.sharding.Mesh | None" = None
 
     @property
     def ff_dim(self) -> int:
@@ -166,11 +171,15 @@ class Attention(nn.Module):
                 Int8DenseGeneral,
             )
 
+            # Megatron layout: q/k/v column-split over heads, o row-split
+            # (its input arrives head-sharded) with one psum per branch
             proj = lambda name: Int8DenseGeneral(  # noqa: E731
-                (h, d), axis=-1, use_bias=False, name=name
+                (h, d), axis=-1, use_bias=False, name=name,
+                mesh=cfg.int8_mesh, shard_kind="column",
             )
             out_proj = Int8DenseGeneral(
-                cfg.d_model, axis=(-2, -1), use_bias=False, name="o_proj"
+                cfg.d_model, axis=(-2, -1), use_bias=False, name="o_proj",
+                mesh=cfg.int8_mesh, shard_kind="row",
             )
         else:
             proj = lambda name: nn.DenseGeneral(  # noqa: E731
@@ -256,16 +265,18 @@ class SwiGLU(nn.Module):
         if cfg.quantized:
             from pytorch_distributed_training_tutorials_tpu.ops.quant import Int8Dense
 
-            dense = lambda f, name: Int8Dense(  # noqa: E731
-                f, use_bias=False, name=name
+            # gate/up column-split over d_ff, down row-split (Megatron MLP)
+            dense = lambda f, name, kind: Int8Dense(  # noqa: E731
+                f, use_bias=False, name=name,
+                mesh=cfg.int8_mesh, shard_kind=kind,
             )
         else:
-            dense = lambda f, name: nn.Dense(  # noqa: E731
+            dense = lambda f, name, kind: nn.Dense(  # noqa: E731
                 f, use_bias=False, dtype=cfg.dtype, name=name
             )
-        gate = nn.silu(dense(cfg.ff_dim, "gate_proj")(x))
-        up = dense(cfg.ff_dim, "up_proj")(x)
-        return dense(cfg.d_model, "down_proj")(gate * up)
+        gate = nn.silu(dense(cfg.ff_dim, "gate_proj", "column")(x))
+        up = dense(cfg.ff_dim, "up_proj", "column")(x)
+        return dense(cfg.d_model, "down_proj", "row")(gate * up)
 
 
 class Block(nn.Module):
@@ -360,7 +371,8 @@ class TransformerLM(nn.Module):
             from pytorch_distributed_training_tutorials_tpu.ops.quant import Int8Dense
 
             return Int8Dense(
-                cfg.vocab_size, use_bias=False, name="lm_head"
+                cfg.vocab_size, use_bias=False, name="lm_head",
+                mesh=cfg.int8_mesh, shard_kind="column",
             )(x)
         return nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head"
@@ -373,19 +385,77 @@ class TransformerLM(nn.Module):
 # vocab-split the LM head; embeddings replicated. Specs shorter than a
 # param's rank are left-padded with None (covers nn.scan's leading layer
 # axis). Consumed by parallel.tensor_parallel.TensorParallel.
+# `(^|/)`-anchored so top-LEVEL params match too: paths are rooted at the
+# tree the consumer walks — "params/lm_head/kernel" in a variables tree but
+# bare "lm_head/kernel" in a params-only tree (audit, quantized loads); a
+# bare `.*/` prefix silently missed the latter and left lm_head replicated.
 TP_RULES: list[tuple[str, P]] = [
-    (r".*/(q_proj|k_proj|v_proj)/kernel", P(None, "model", None)),
-    (r".*/o_proj/kernel", P("model", None, None)),
-    (r".*/(gate_proj|up_proj)/kernel", P(None, "model")),
-    (r".*/down_proj/kernel", P("model", None)),
-    (r".*/tok_emb/embedding", P(None, None)),
-    (r".*/lm_head/kernel", P(None, "model")),
+    (r"(^|/)(q_proj|k_proj|v_proj)/kernel$", P(None, "model", None)),
+    (r"(^|/)o_proj/kernel$", P("model", None, None)),
+    (r"(^|/)(gate_proj|up_proj)/kernel$", P(None, "model")),
+    (r"(^|/)down_proj/kernel$", P("model", None)),
+    (r"(^|/)tok_emb/embedding$", P(None, None)),
+    (r"(^|/)lm_head/kernel$", P(None, "model")),
 ]
 
 
 def ep_rules() -> list[tuple[str, P]]:
     """TP + expert-parallel rules for an MoE transformer (dp x tp x ep)."""
     return MOE_RULES + TP_RULES
+
+
+# The int8 analog of TP_RULES for the {'q', 'scale'} serving layout (all
+# kernels stored flattened 2-D (in, out) by Int8Dense/Int8DenseGeneral):
+# column-parallel layers split q AND their per-output-column scales on the
+# output dim; row-parallel layers split q on the input dim and replicate
+# scales (each shard's partial is already scale-multiplied before the psum
+# — ops.quant.int8_matmul_tp). Embeddings/norms stay replicated float, the
+# mixed layout the reference's cell-4 param audit shows
+# (/root/reference/03.model_parallel.ipynb:409).
+def int8_param_sharding(path: str, ndim: int, mesh):
+    """The one place INT8_TP_RULES turns into a placement: NamedSharding
+    for one serving-tree leaf (float leaves fall through to replicated).
+    Shared by :func:`load_quantized_lm`'s streaming placement and by
+    :func:`place_int8_lm_params` (and the dryrun's certification of both)."""
+    from jax.sharding import NamedSharding
+
+    from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import (
+        spec_for_path,
+    )
+
+    return NamedSharding(
+        mesh, spec_for_path(path, ndim, INT8_TP_RULES, mesh=mesh)
+    )
+
+
+def place_int8_lm_params(params, mesh):
+    """Place an in-memory int8 serving tree (:func:`quantize_lm_params`
+    output) onto ``mesh`` per :data:`INT8_TP_RULES`."""
+    from pytorch_distributed_training_tutorials_tpu.utils.tree import keystr
+
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, leaf: jax.device_put(
+            leaf,
+            int8_param_sharding(
+                keystr(kp), getattr(leaf, "ndim", 0), mesh
+            ),
+        ),
+        params,
+    )
+
+
+INT8_TP_RULES: list[tuple[str, P]] = [
+    (
+        r"(^|/)(q_proj|k_proj|v_proj|gate_proj|up_proj|lm_head)/q$",
+        P(None, "model"),
+    ),
+    (
+        r"(^|/)(q_proj|k_proj|v_proj|gate_proj|up_proj|lm_head)/scale$",
+        P(None, "model"),
+    ),
+    (r"(^|/)(o_proj|down_proj)/q$", P("model", None)),
+    (r"(^|/)(o_proj|down_proj)/scale$", P(None, None)),
+]
 
 
 # the matmul weights int8 serving replaces (embeddings + norms stay float —
@@ -458,7 +528,7 @@ def _quantize_kernel(name: str, kernel, quantize_int8) -> dict:
     return {"q": qp.q, "scale": qp.scale.reshape(1, -1)}
 
 
-def load_quantized_lm(path):
+def load_quantized_lm(path, mesh=None):
     """Stream a trained f32 :class:`TransformerLM` checkpoint straight into
     the ``quantized=True`` serving layout, one leaf at a time.
 
@@ -469,6 +539,13 @@ def load_quantized_lm(path):
     the f32 model is never resident on host. Serve with
     ``TransformerLM(replace(cfg, quantized=True))`` and
     :func:`..models.generate.generate`.
+
+    With ``mesh`` (a ``{'model': M, ...}`` mesh), every quantized leaf is
+    placed onto devices per :data:`INT8_TP_RULES` (float leaves replicate)
+    as soon as it is produced — the ``device_map="auto"`` + 8-bit + *bigger
+    than one chip* combination: host peak stays one-leaf-bounded AND no
+    device ever holds more than its 1/M shard of the int8 weights. Pass
+    ``dataclasses.replace(cfg, quantized=True, int8_mesh=mesh)`` to serve.
     """
     import orbax.checkpoint as ocp
 
@@ -477,6 +554,16 @@ def load_quantized_lm(path):
         checkpoint_leaf_metadata,
         restore_leaf,
     )
+
+    def place(keys: list[str], leaf):
+        if mesh is None:
+            return leaf
+        return jax.device_put(
+            leaf,
+            int8_param_sharding(
+                "/".join(keys), getattr(leaf, "ndim", 0), mesh
+            ),
+        )
 
     flat, _ = checkpoint_leaf_metadata(path)
     out: dict = {}
@@ -494,10 +581,14 @@ def load_quantized_lm(path):
                 and keys[-1] == "kernel"
                 and keys[-2] in _QUANTIZED_KERNELS
             ):
-                node.update(
-                    _quantize_kernel(keys[-2], leaf, quantize_int8)
-                )
+                qs = _quantize_kernel(keys[-2], leaf, quantize_int8)
                 del leaf  # free the f32 kernel before the next read
+                node.update(
+                    {
+                        k: place(keys[:-1] + [k], v)
+                        for k, v in qs.items()
+                    }
+                )
             else:
-                node[keys[-1]] = leaf
+                node[keys[-1]] = place(keys, leaf)
     return out

@@ -28,8 +28,10 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from flax import struct
+from jax import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 class Int8Param(struct.PyTreeNode):
@@ -185,6 +187,84 @@ def int8_matmul(
     return out[:m, :n] if (pad_m or pad_n) else out
 
 
+def int8_matmul_tp(
+    x: jax.Array,
+    w: Int8Param,
+    mesh: Mesh,
+    *,
+    kind: str,
+    axis: str = "model",
+    data_axis: str = "data",
+) -> jax.Array:
+    """Tensor-parallel ``x @ (q * scale)``: the Pallas kernel under an
+    explicit :func:`jax.shard_map` (a ``pallas_call`` is a single-device
+    program — GSPMD cannot partition it, so the Megatron split is stated
+    here rather than propagated).
+
+    The int8 twin of the float TP layout
+    (:data:`..models.transformer.TP_RULES`):
+
+    - ``kind="column"``: ``q`` (K, N) and per-column ``scale`` split over
+      ``axis`` on N; every device runs the full-K kernel on its column
+      shard. Activation quantization sees the same (row, K-tile) groups as
+      the unsharded kernel — numerics are identical.
+    - ``kind="row"``: ``q`` split over ``axis`` on K, ``scale`` replicated;
+      each device multiplies its K-shard (activations arrive feature-
+      sharded from the previous column layer) and a ``psum`` over ``axis``
+      sums the partials — the one allreduce per residual branch. Activation
+      quantization groups are per (row, *local* K-tile), a regrouping of
+      the unsharded kernel's tiles: same error scale, bit-different values
+      (``tests/test_quant.py`` pins the sharded math exactly against a
+      per-shard reference composition).
+
+    ``x``: (M, K) with rows optionally sharded over ``data_axis`` (M must
+    then divide by it). Requires N (column) / K (row) divisible by the
+    ``axis`` size. Serving-only, like the kernel itself.
+    """
+    if axis not in mesh.shape:
+        raise ValueError(f"mesh has no {axis!r} axis: {dict(mesh.shape)}")
+    n_shards = mesh.shape[axis]
+    m, k = x.shape
+    _, n = w.q.shape
+    scale_row = w.scale.reshape(1, n).astype(jnp.float32)
+    # shard rows over the data axis only when they divide it — a decode
+    # step's M is batch*1 and need not match the mesh (replicated rows are
+    # correct, just unsharded work)
+    dspec = (
+        data_axis
+        if data_axis in mesh.shape and m % mesh.shape[data_axis] == 0
+        else None
+    )
+
+    if kind == "column":
+        if n % n_shards:
+            raise ValueError(f"column split needs N ({n}) % {n_shards} == 0")
+        in_specs = (P(dspec, None), P(None, axis), P(None, axis))
+        out_specs = P(dspec, axis)
+
+        def f(xl, ql, sl):
+            return int8_matmul(xl, Int8Param(q=ql, scale=sl))
+
+    elif kind == "row":
+        if k % n_shards:
+            raise ValueError(f"row split needs K ({k}) % {n_shards} == 0")
+        in_specs = (P(dspec, axis), P(axis, None), P(None, None))
+        out_specs = P(dspec, None)
+
+        def f(xl, ql, sl):
+            part = int8_matmul(xl, Int8Param(q=ql, scale=sl))
+            return jax.lax.psum(part, axis)
+
+    else:
+        raise ValueError(f"kind must be 'column' or 'row', got {kind!r}")
+
+    # check_vma=False: pallas_call outputs carry no varying-mesh-axes info
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    )(x, w.q, scale_row)
+
+
 def int8_matmul_reference(
     x: jax.Array, w: Int8Param, *, block_k: int = 512
 ) -> jax.Array:
@@ -215,7 +295,9 @@ def int8_matmul_reference(
 def _int8_affine(mod: nn.Module, x, feats: tuple, n_in: int, use_bias: bool):
     """The shared body of the int8 serving layers: flattened 2-D ``q`` +
     per-column ``scale`` params, the K-blocked MXU matmul, reshape, bias —
-    one copy for Int8Dense and Int8DenseGeneral."""
+    one copy for Int8Dense and Int8DenseGeneral. With ``mod.mesh`` +
+    ``mod.shard_kind`` set (and the axis really in the mesh), the matmul
+    runs tensor-parallel through :func:`int8_matmul_tp`."""
     in_dims = x.shape[x.ndim - n_in :]
     k = 1
     for d in in_dims:
@@ -228,9 +310,20 @@ def _int8_affine(mod: nn.Module, x, feats: tuple, n_in: int, use_bias: bool):
         "scale", nn.initializers.ones, (1, n_out), jnp.float32
     )
     lead = x.shape[: x.ndim - n_in]
-    out = int8_matmul(
-        x.reshape(-1, k), Int8Param(q=q, scale=scale)
-    ).reshape(*lead, *feats)
+    w = Int8Param(q=q, scale=scale)
+    x2 = x.reshape(-1, k)
+    mesh = getattr(mod, "mesh", None)
+    if (
+        mesh is not None
+        and mod.shard_kind is not None
+        and mesh.shape.get(mod.shard_axis, 1) > 1
+    ):
+        out2 = int8_matmul_tp(
+            x2, w, mesh, kind=mod.shard_kind, axis=mod.shard_axis
+        )
+    else:
+        out2 = int8_matmul(x2, w)
+    out = out2.reshape(*lead, *feats)
     if use_bias:
         out = out + mod.param(
             "bias", nn.initializers.zeros, feats, jnp.float32
@@ -246,10 +339,17 @@ class Int8Dense(nn.Module):
     kernel (:func:`quantize_int8` / :func:`..parallel.auto.load_quantized`).
     Zero-initialized when built fresh: this module is for loading quantized
     checkpoints, not training (int8 has no useful gradient).
+
+    ``mesh`` + ``shard_kind`` ('column' | 'row') switch the matmul to the
+    tensor-parallel :func:`int8_matmul_tp`; param shardings come from
+    :data:`..models.transformer.INT8_TP_RULES`.
     """
 
     features: int
     use_bias: bool = True
+    mesh: Mesh | None = None
+    shard_kind: str | None = None
+    shard_axis: str = "model"
 
     @nn.compact
     def __call__(self, x):
@@ -271,6 +371,9 @@ class Int8DenseGeneral(nn.Module):
     features: int | tuple[int, ...]
     axis: int | tuple[int, ...] = -1
     use_bias: bool = False
+    mesh: Mesh | None = None
+    shard_kind: str | None = None
+    shard_axis: str = "model"
 
     @nn.compact
     def __call__(self, x):

@@ -384,6 +384,7 @@ class Trainer:
         grad_accum_steps: int = 1,
         seed: int = 0,
         log_every: int | None = None,
+        defer_host_fetch: bool = False,
     ):
         self.model = model
         self.loader = train_loader
@@ -437,12 +438,23 @@ class Trainer:
                     stacklevel=2,
                 )
         self.log_every = log_every
+        # defer_host_fetch: end chunked epochs with block_until_ready
+        # (completion only) instead of a per-epoch loss fetch — standard
+        # TPU practice to keep host-device syncs out of the training loop.
+        # Losses stay on device in ``last_epoch_losses``; fetch after
+        # training via :meth:`fetch_last_loss`. (On tunneled runtimes the
+        # resulting wall-clock is NOT trustworthy without a terminal fetch
+        # — see the CLAUDE.md async-mirage note.)
+        self.defer_host_fetch = defer_host_fetch
+        self.last_epoch_losses = None  # device array, chunked path only
         self.loss_name = loss
         self.aux_loss_weight = aux_loss_weight
+        self.grad_accum_steps = grad_accum_steps
         self.last_epoch_metrics: dict = {}
         self.epoch = 0  # next epoch to run; advanced by train(), restored
         self._eval_step = None
         self._epoch_scan = None
+        self._chunk_scan = None
 
     def _epoch_metrics(self, epoch: int, loss, steps: int, dt: float) -> dict:
         """Shared metric dict + per-epoch log line for both epoch paths
@@ -540,9 +552,75 @@ class Trainer:
         self.last_epoch_metrics = m  # keep the train()-path contract
         return m
 
+    def _run_epoch_chunked(self, epoch: int) -> dict:
+        """Streaming twin of the epoch scan: each prefetched multi-step
+        chunk (:meth:`..data.streaming.ChunkedStreamingLoader.iter_chunks`)
+        trains as ONE compiled ``lax.scan`` launch, while the next chunk's
+        gather + H2D runs in the background — the per-step dispatch and
+        transfer latency the round-2 profile flagged amortizes over the
+        chunk length."""
+        loader = self.loader
+        loader.set_epoch(epoch)
+        log0(
+            epoch_line(
+                self.strategy.num_devices, epoch,
+                loader.per_device_batch, len(loader),
+            )
+        )
+        if self._chunk_scan is None:
+            step_fn = _train_step_fn(
+                self.loss_name, self.has_batch_stats, self.aux_loss_weight
+            )
+            transform = loader.transform
+
+            def chunk_scan(state, chunk):
+                def body(state, batch):
+                    if transform is not None:
+                        batch = transform(*batch)
+                    state, metrics = step_fn(state, batch)
+                    return state, metrics["loss"]
+
+                return jax.lax.scan(body, state, chunk)
+
+            # two compilations at most: full chunks + a shorter tail chunk
+            self._chunk_scan = jax.jit(chunk_scan, donate_argnums=0)
+        t0 = time.perf_counter()
+        losses = []
+        steps = 0
+        for chunk in loader.iter_chunks():
+            steps += jax.tree_util.tree_leaves(chunk)[0].shape[0]
+            self.state, chunk_losses = self._chunk_scan(self.state, chunk)
+            losses.append(chunk_losses)
+        self.last_epoch_losses = losses[-1] if losses else None
+        if self.defer_host_fetch:
+            # completion sync only — no D2H (see defer_host_fetch in
+            # __init__ for why a fetch here would poison later epochs'
+            # input bandwidth on tunneled runtimes)
+            if losses:
+                jax.block_until_ready(losses[-1])
+            loss = None
+        else:
+            loss = float(losses[-1][-1]) if losses else None
+        dt = time.perf_counter() - t0
+        return self._epoch_metrics(epoch, loss, steps, dt)
+
+    def fetch_last_loss(self) -> float:
+        """Fetch the deferred final loss of the last chunked epoch (a D2H
+        read — call AFTER throughput-sensitive work)."""
+        if self.last_epoch_losses is None:
+            raise ValueError("no deferred losses recorded")
+        return float(self.last_epoch_losses[-1])
+
     def _run_epoch(self, epoch: int) -> dict:
         if getattr(self.loader, "device_arrays", None) is not None:
             return self._run_epoch_scanned(epoch)
+        if (
+            getattr(self.loader, "iter_chunks", None) is not None
+            and self.grad_accum_steps == 1
+        ):
+            # grad accumulation composes with the per-step path only (its
+            # microbatching lives inside make_train_step)
+            return self._run_epoch_chunked(epoch)
         self.loader.set_epoch(epoch)  # reference ddp_gpus.py:45
         log0(
             epoch_line(

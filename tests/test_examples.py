@@ -36,3 +36,14 @@ def test_llm_example_runs(mode):
     ])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "epoch 0: loss" in out.stdout
+
+
+@pytest.mark.slow
+def test_int8_serving_example_runs(tmp_path):
+    out = _run([
+        "examples/serve_llm_int8.py", "--preset", "toy", "--tp", "2",
+        "--prompt_len", "8", "--new_tokens", "4", "--batch", "2",
+        "--ckpt_dir", str(tmp_path / "ck"),
+    ])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "serve:" in out.stdout and "load:" in out.stdout

@@ -9,8 +9,10 @@ from pytorch_distributed_training_tutorials_tpu.ops.quant import (
     Int8Param,
     int8_matmul,
     int8_matmul_reference,
+    int8_matmul_tp,
     quantize_int8,
 )
+from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
 
 
 def _w(shape, seed=0):
@@ -165,3 +167,60 @@ def test_int8_matmul_llama_width_tiles():
     out = int8_matmul(x, w, block_m=256, block_n=256, block_k=512)
     ref = int8_matmul_reference(x, w, block_k=512)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=1e-4)
+
+
+def test_int8_matmul_tp_column_exact():
+    """Column split doesn't change activation-quantization grouping: the
+    TP kernel must equal the unsharded kernel bit-for-bit (float tol)."""
+    mesh = create_mesh({"data": 2, "model": 4})
+    x = jnp.asarray(_w((16, 256), seed=10))
+    w = quantize_int8(jnp.asarray(_w((256, 512), seed=11)))
+    np.testing.assert_allclose(
+        np.asarray(int8_matmul_tp(x, w, mesh, kind="column")),
+        np.asarray(int8_matmul(x, w)),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_int8_matmul_tp_row_matches_shard_composition():
+    """Row split quantizes activations per (row, local K-tile); the exact
+    statement of its math is the psum of per-shard reference matmuls."""
+    mesh = create_mesh({"data": 2, "model": 4})
+    x = jnp.asarray(_w((16, 256), seed=12))
+    w = quantize_int8(jnp.asarray(_w((256, 512), seed=13)))
+    out = int8_matmul_tp(x, w, mesh, kind="row")
+    kk = 256 // 4
+    exp = sum(
+        np.asarray(
+            int8_matmul_reference(
+                x[:, i * kk : (i + 1) * kk],
+                Int8Param(q=w.q[i * kk : (i + 1) * kk], scale=w.scale),
+            )
+        )
+        for i in range(4)
+    )
+    np.testing.assert_allclose(np.asarray(out), exp, rtol=1e-4, atol=1e-4)
+    # regrouping error stays int8-sized vs the unsharded kernel
+    base = np.asarray(int8_matmul(x, w))
+    assert np.abs(np.asarray(out) - base).max() < 0.05 * np.abs(base).max()
+
+
+def test_int8_matmul_tp_validates():
+    import pytest
+
+    mesh = create_mesh({"data": 8})
+    x = jnp.asarray(_w((8, 64), seed=1))
+    w = quantize_int8(jnp.asarray(_w((64, 64), seed=2)))
+    with pytest.raises(ValueError, match="no 'model' axis"):
+        int8_matmul_tp(x, w, mesh, kind="column")
+    mesh2 = create_mesh({"model": 8})
+    with pytest.raises(ValueError, match="column split needs"):
+        int8_matmul_tp(x, quantize_int8(jnp.asarray(_w((64, 36), 3))), mesh2, kind="column")
+    with pytest.raises(ValueError, match="row split needs"):
+        int8_matmul_tp(
+            jnp.asarray(_w((8, 36), 4)),
+            quantize_int8(jnp.asarray(_w((36, 64), 5))),
+            mesh2, kind="row",
+        )
+    with pytest.raises(ValueError, match="kind must be"):
+        int8_matmul_tp(x, w, mesh2, kind="diag")
