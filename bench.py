@@ -12,9 +12,9 @@ device-resident one (``data/resident.py``): the dataset is placed in HBM
 once, and the measured region is a multi-epoch ``lax.scan`` whose body
 gathers each step's batch on device — one XLA launch and one host fetch for
 the whole region (a device-trace profile showed per-epoch launch/fetch
-costing ~8% on the tunneled runtime; the remaining step time is dominated by
-BatchNorm statistics/elementwise fusions, not convolutions — see the round-2
-commit message for the trace analysis). The JSON line carries the honesty
+costing ~8% on the tunneled runtime; the step itself is ~85% convolution
+fusions — see PROFILE_r04.md for the HLO-verified breakdown that corrected
+round 2's "BN-bound" misread). The JSON line carries the honesty
 metadata: whether the data was a synthetic surrogate (no network egress in
 the build env), a breakdown (streaming input pipeline alone, train step
 alone), and the held-out eval accuracy against the stated 0.99 target (the
@@ -116,10 +116,12 @@ def main() -> None:
         # a real fetch of the last chunk's bytes
         t0 = time.perf_counter()
         n_steps = 0
+        chunk = None
         for chunk in chunked.iter_chunks():
             jax.block_until_ready(chunk)
             n_steps += chunk[0].shape[0]
-        float(chunk[1][-1, -1])  # terminal fetch: close the async pipeline
+        if chunk is not None:  # terminal fetch: close the async pipeline
+            float(chunk[1][-1, -1])
         input_images_s = n_steps * chunked.global_batch / (
             time.perf_counter() - t0
         )
@@ -159,7 +161,14 @@ def main() -> None:
                 s, m = step_fn(s, batch)
                 return s, m["loss"]
 
-            return jax.lax.scan(body, state, None, length=chain_len)
+            # unroll=8: amortizes while-loop bookkeeping and halves the
+            # loop-boundary state copies (round-4 trace: device time 10.60
+            # -> 10.23 ms/step on this leg; see PROFILE_r04.md). The real
+            # epoch scan measured NO reliable unroll win (its body gathers
+            # the batch), so only this cached-batch leg uses it.
+            return jax.lax.scan(
+                body, state, None, length=chain_len, unroll=8
+            )
 
         state = trainer.state
         state, losses = chain(state)  # compile

@@ -278,6 +278,7 @@ def make_epoch_scan(
     has_batch_stats: bool = False,
     aux_loss_weight: float = 0.0,
     transform=None,
+    unroll: int = 1,
 ):
     """Build a jitted *whole-epoch* program: ``lax.scan`` of the train step
     over a device-resident dataset.
@@ -290,6 +291,13 @@ def make_epoch_scan(
     happens inside the scan body, so XLA fuses it into the step. Replaces the
     reference's per-step ``for ... in dataloader`` hot loop
     (``ddp_gpus.py:46-49``) with one program launch per epoch.
+
+    ``unroll`` passes through to ``lax.scan``: unrolling the step body lets
+    XLA amortize while-loop bookkeeping and the carried-state copies across
+    iterations (measured round 4 on v5e: unroll=8 removed ~4% of step time
+    on the ResNet-18 bs512 leg — the loop-boundary ``copy-start/copy-done``
+    pairs halved). Costs compile time roughly linearly; 1 (no unroll) keeps
+    test-suite compiles fast.
     """
     step_fn = _train_step_fn(loss, has_batch_stats, aux_loss_weight)
 
@@ -301,7 +309,7 @@ def make_epoch_scan(
             state, metrics = step_fn(state, batch)
             return state, metrics["loss"]
 
-        state, losses = jax.lax.scan(body, state, idx)
+        state, losses = jax.lax.scan(body, state, idx, unroll=unroll)
         return state, losses
 
     return jax.jit(epoch_fn, donate_argnums=0)
@@ -385,6 +393,7 @@ class Trainer:
         seed: int = 0,
         log_every: int | None = None,
         defer_host_fetch: bool = False,
+        scan_unroll: int = 1,
     ):
         self.model = model
         self.loader = train_loader
@@ -438,6 +447,14 @@ class Trainer:
                     stacklevel=2,
                 )
         self.log_every = log_every
+        # scan_unroll: lax.scan unroll factor for the compiled epoch/chunk
+        # scans (see make_epoch_scan) — a perf knob for long device-resident
+        # or chunked runs; leave 1 where compile time matters more (tests).
+        # Baked into the cached scan at first trace — set it here, not after
+        # an epoch has run.
+        if scan_unroll < 1:
+            raise ValueError(f"scan_unroll must be >= 1, got {scan_unroll}")
+        self.scan_unroll = scan_unroll
         # defer_host_fetch: end chunked epochs with block_until_ready
         # (completion only) instead of a per-epoch loss fetch — standard
         # TPU practice to keep host-device syncs out of the training loop.
@@ -484,6 +501,7 @@ class Trainer:
                 has_batch_stats=self.has_batch_stats,
                 aux_loss_weight=self.aux_loss_weight,
                 transform=loader.transform,
+                unroll=self.scan_unroll,
             )
         log0(
             epoch_line(
@@ -520,6 +538,7 @@ class Trainer:
                 has_batch_stats=self.has_batch_stats,
                 aux_loss_weight=self.aux_loss_weight,
                 transform=loader.transform,
+                unroll=self.scan_unroll,
             )
         idx = jnp.concatenate(
             [
@@ -580,7 +599,7 @@ class Trainer:
                     state, metrics = step_fn(state, batch)
                     return state, metrics["loss"]
 
-                return jax.lax.scan(body, state, chunk)
+                return jax.lax.scan(body, state, chunk, unroll=self.scan_unroll)
 
             # two compilations at most: full chunks + a shorter tail chunk
             self._chunk_scan = jax.jit(chunk_scan, donate_argnums=0)
