@@ -43,41 +43,30 @@ def main() -> None:
     import jax
     import time
 
-    import jax.numpy as jnp
     import optax
 
+    from pytorch_distributed_training_tutorials_tpu.bench.headline import (
+        make_headline_setup,
+        make_step_chain,
+    )
     from pytorch_distributed_training_tutorials_tpu.data import (
         ChunkedStreamingLoader,
         DeviceResidentLoader,
-        ShardedLoader,
         mnist,
     )
-    from pytorch_distributed_training_tutorials_tpu.models import resnet18
-    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
     from pytorch_distributed_training_tutorials_tpu.train import Trainer
-    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
-        _train_step_fn,
-    )
 
-    mesh = create_mesh()
+    # the canonical workload (uint8-resident MNIST, bf16 cifar-stem
+    # ResNet-18, SGD+momentum) — shared with scripts/profile_step.py and
+    # scripts/step_time_experiment.py so the profiler measures exactly what
+    # this headline reports
+    setup = make_headline_setup(per_device_batch=512)
+    mesh, ds, loader, trainer = (
+        setup.mesh, setup.dataset, setup.loader, setup.trainer
+    )
+    model = trainer.model
     n_chips = mesh.devices.size
-    per_device_batch = 512
-
-    # uint8 at rest in HBM (the on-disk dtype, 1/4 the f32 bytes, ~4x less
-    # per-step gather traffic); the /255 normalize runs inside the compiled
-    # step and fuses into the stem convolution
-    ds = mnist("train", raw=True)
-    loader = DeviceResidentLoader(
-        ds,
-        per_device_batch,
-        mesh,
-        seed=0,
-        transform=lambda x, y: (x.astype(jnp.bfloat16) / 255.0, y),
-    )
-    model = resnet18(num_classes=10, stem="cifar", dtype=jnp.bfloat16)
-    trainer = Trainer(
-        model, loader, optax.sgd(0.05, momentum=0.9), loss="cross_entropy"
-    )
+    per_device_batch = setup.per_device_batch
 
     fused_epochs = 3
     with contextlib.redirect_stdout(sys.stderr):
@@ -125,7 +114,6 @@ def main() -> None:
         input_images_s = n_steps * chunked.global_batch / (
             time.perf_counter() - t0
         )
-        streaming = ShardedLoader(ds, per_device_batch, mesh, seed=0)
 
         # Headline: epoch 0 compiles the per-epoch program; the first fused
         # call compiles the fused-run program (different scan length); the
@@ -145,30 +133,14 @@ def main() -> None:
         # slope-timed individual dispatches, which over-reported ~60% on the
         # tunneled runtime vs the XLA device trace; the scanned chain matches
         # the trace's per-step time.)
-        # normalized once outside the chain via the loader's jitted transform
-        # (same bf16 dtype semantics as the in-scan path — a host-side numpy
-        # transform would silently promote to f32 and time the wrong step):
-        # this leg isolates the train step itself
-        batch = jax.block_until_ready(
-            loader._apply_transform(next(iter(streaming)))
-        )
-        step_fn = _train_step_fn("cross_entropy", has_batch_stats=True)
+        # the cached batch is normalized by the loader's jitted transform
+        # (same bf16 dtype semantics as the in-scan path); unroll=8
+        # amortizes while-loop bookkeeping and halves the loop-boundary
+        # state copies (round-4 trace: device 10.60 -> 10.23 ms/step; see
+        # PROFILE_r04.md). The real epoch scan measured NO reliable unroll
+        # win (its body gathers the batch), so only this cached leg uses it.
         chain_len = 256
-
-        @jax.jit
-        def chain(state):
-            def body(s, _):
-                s, m = step_fn(s, batch)
-                return s, m["loss"]
-
-            # unroll=8: amortizes while-loop bookkeeping and halves the
-            # loop-boundary state copies (round-4 trace: device time 10.60
-            # -> 10.23 ms/step on this leg; see PROFILE_r04.md). The real
-            # epoch scan measured NO reliable unroll win (its body gathers
-            # the batch), so only this cached-batch leg uses it.
-            return jax.lax.scan(
-                body, state, None, length=chain_len, unroll=8
-            )
+        chain = make_step_chain(setup, chain_len, unroll=8)
 
         state = trainer.state
         state, losses = chain(state)  # compile
@@ -177,7 +149,7 @@ def main() -> None:
         state, losses = chain(state)
         float(losses[-1])
         step_images_s = (
-            chain_len * streaming.global_batch / (time.perf_counter() - t0)
+            chain_len * loader.global_batch / (time.perf_counter() - t0)
         )
 
         # Accuracy demonstration (BASELINE north star: "reaches reference

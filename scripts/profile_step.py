@@ -109,46 +109,21 @@ def source_group(op_name: str) -> str:
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
-    import optax
 
-    from pytorch_distributed_training_tutorials_tpu.data import (
-        DeviceResidentLoader,
-        ShardedLoader,
-        mnist,
-    )
-    from pytorch_distributed_training_tutorials_tpu.models import resnet18
-    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
-    from pytorch_distributed_training_tutorials_tpu.train import Trainer
-    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
-        _train_step_fn,
+    from pytorch_distributed_training_tutorials_tpu.bench.headline import (
+        make_headline_setup,
+        make_step_chain,
     )
     from pytorch_distributed_training_tutorials_tpu.utils import profiling
 
-    mesh = create_mesh()
-    per_device_batch = 512
-    ds = mnist("train", raw=True)
-    loader = DeviceResidentLoader(
-        ds, per_device_batch, mesh, seed=0,
-        transform=lambda x, y: (x.astype(jnp.bfloat16) / 255.0, y),
-    )
-    model = resnet18(num_classes=10, stem="cifar", dtype=jnp.bfloat16)
-    trainer = Trainer(
-        model, loader, optax.sgd(0.05, momentum=0.9), loss="cross_entropy"
-    )
-    streaming = ShardedLoader(ds, per_device_batch, mesh, seed=0)
-    batch = jax.block_until_ready(
-        loader._apply_transform(next(iter(streaming)))
-    )
-    step_fn = _train_step_fn("cross_entropy", has_batch_stats=True)
-
-    @jax.jit
-    def chain(state):
-        def body(s, _):
-            s, m = step_fn(s, batch)
-            return s, m["loss"]
-
-        return jax.lax.scan(body, state, None, length=CHAIN_LEN)
+    # the exact headline workload (shared with bench.py's step leg)
+    setup = make_headline_setup()
+    trainer, batch, step_fn = setup.trainer, setup.batch, setup.step_fn
+    per_device_batch = setup.per_device_batch
+    # unroll=1 here: clean per-op attribution (unrolled bodies duplicate
+    # every op name 8x); the unroll effect itself is covered in the
+    # "Actions taken" narrative below
+    chain = make_step_chain(setup, CHAIN_LEN, unroll=1)
 
     compiled = chain.lower(trainer.state).compile()
     hlo_info = parse_hlo(compiled.as_text())

@@ -1,7 +1,8 @@
 """Time the train-step chain under a given config (one process per config).
 
-Usage: python scripts/step_time_experiment.py [per_device_batch]
+Usage: python scripts/step_time_experiment.py [per_device_batch] [unroll]
 with XLA_FLAGS set in the environment as desired. Prints one JSON line.
+Measures exactly the headline workload (bench.headline.make_headline_setup).
 """
 
 from __future__ import annotations
@@ -16,48 +17,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
-    import optax
 
-    from pytorch_distributed_training_tutorials_tpu.data import (
-        DeviceResidentLoader,
-        ShardedLoader,
-        mnist,
-    )
-    from pytorch_distributed_training_tutorials_tpu.models import resnet18
-    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
-    from pytorch_distributed_training_tutorials_tpu.train import Trainer
-    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
-        _train_step_fn,
+    from pytorch_distributed_training_tutorials_tpu.bench.headline import (
+        make_headline_setup,
+        make_step_chain,
     )
 
     per_device_batch = int(sys.argv[1]) if len(sys.argv) > 1 else 512
-    mesh = create_mesh()
-    ds = mnist("train", raw=True)
-    loader = DeviceResidentLoader(
-        ds, per_device_batch, mesh, seed=0,
-        transform=lambda x, y: (x.astype(jnp.bfloat16) / 255.0, y),
-    )
-    model = resnet18(num_classes=10, stem="cifar", dtype=jnp.bfloat16)
-    trainer = Trainer(
-        model, loader, optax.sgd(0.05, momentum=0.9), loss="cross_entropy"
-    )
-    streaming = ShardedLoader(ds, per_device_batch, mesh, seed=0)
-    batch = jax.block_until_ready(
-        loader._apply_transform(next(iter(streaming)))
-    )
-    step_fn = _train_step_fn("cross_entropy", has_batch_stats=True)
+    unroll = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    setup = make_headline_setup(per_device_batch)
     chain_len = 256
+    chain = make_step_chain(setup, chain_len, unroll=unroll)
 
-    @jax.jit
-    def chain(state):
-        def body(s, _):
-            s, m = step_fn(s, batch)
-            return s, m["loss"]
-
-        return jax.lax.scan(body, state, None, length=chain_len)
-
-    state = trainer.state
+    state = setup.trainer.state
     state, losses = chain(state)  # compile + prime first fetch
     float(losses[-1])
     t0 = time.perf_counter()
@@ -66,6 +38,7 @@ def main() -> None:
     dt = time.perf_counter() - t0
     print(json.dumps({
         "per_device_batch": per_device_batch,
+        "unroll": unroll,
         "xla_flags": os.environ.get("XLA_FLAGS", ""),
         "ms_per_step": round(dt * 1e3 / chain_len, 3),
         "images_per_sec_per_chip": round(
