@@ -1,0 +1,92 @@
+"""Full-scale falsifiability receipt for the accuracy demonstration.
+
+Runs the exact bench workload (ResNet-18 bs512 bf16, 7 epochs on the
+hardened MNIST surrogate) twice on the real chip — once healthy, once with
+a deliberately broken config (lr=10, divergent) — and writes
+``ACCURACY_r04.json``: the committed proof that ``reaches_accuracy_target``
+can fail (round-3 verdict task 4).
+
+Run:  python scripts/accuracy_demo.py
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_config(lr: float) -> dict:
+    import jax.numpy as jnp
+    import optax
+
+    from pytorch_distributed_training_tutorials_tpu.data import (
+        DeviceResidentLoader,
+        mnist,
+    )
+    from pytorch_distributed_training_tutorials_tpu.models import resnet18
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (
+        create_mesh,
+    )
+    from pytorch_distributed_training_tutorials_tpu.train import Trainer
+
+    mesh = create_mesh()
+    tf = lambda x, y: (x.astype(jnp.bfloat16) / 255.0, y)  # noqa: E731
+    loader = DeviceResidentLoader(
+        mnist("train", raw=True), 512, mesh, seed=0, transform=tf
+    )
+    trainer = Trainer(
+        resnet18(num_classes=10, stem="cifar", dtype=jnp.bfloat16),
+        loader, optax.sgd(lr, momentum=0.9), loss="cross_entropy",
+    )
+    with contextlib.redirect_stdout(sys.stderr):
+        trainer._run_epoch(0)
+        trainer.run_epochs_fused(1, 3)
+        trainer.run_epochs_fused(4, 3)
+        m = trainer.evaluate(
+            DeviceResidentLoader(
+                mnist("test", raw=True), 512, mesh, seed=0, transform=tf
+            )
+        )
+    return {
+        "lr": lr,
+        "epochs": 7,
+        "eval_accuracy": round(m["accuracy"], 4),
+        "eval_loss": round(m["loss"], 6),
+        "reaches_accuracy_target": bool(m["accuracy"] >= 0.99),
+    }
+
+
+def main() -> None:
+    result = {
+        "workload": (
+            "ResNet-18 cifar-stem bs512 bf16, hardened MNIST surrogate "
+            "(multi-modal templates, signal=0.35 — data/datasets.py), "
+            "7 epochs, eval on held-out split with wrap-padding masked"
+        ),
+        "accuracy_target": 0.99,
+        "healthy": run_config(lr=0.05),
+        "broken_lr": run_config(lr=10.0),
+    }
+    ok = (
+        result["healthy"]["reaches_accuracy_target"]
+        and not result["broken_lr"]["reaches_accuracy_target"]
+    )
+    result["falsifiable"] = bool(ok)
+    out = json.dumps(result, indent=2)
+    with open(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "ACCURACY_r04.json",
+        ),
+        "w",
+    ) as f:
+        f.write(out + "\n")
+    print(out)
+
+
+if __name__ == "__main__":
+    main()
