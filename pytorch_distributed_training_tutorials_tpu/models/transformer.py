@@ -252,6 +252,16 @@ class Attention(nn.Module):
                 if cfg.attention_fn is not None
                 else causal_attention
             )
+            if prefill and getattr(attn, "requires_seq_divisible", False):
+                # sequence-parallel schedules (ring/Ulysses) require the
+                # sequence to divide the seq mesh axis, which arbitrary
+                # prompt lengths don't satisfy — prefill falls back to the
+                # causal-equivalent dense path for THOSE fns only (flagged
+                # via requires_seq_divisible; the cache contents, raw K/V,
+                # are attention-independent either way). Other custom fns
+                # (e.g. the Pallas flash kernel) handle any length and keep
+                # their memory advantages during prefill. (ADVICE r3)
+                attn = causal_attention
             out = attn(q, k, v)
         return out_proj(out)
 

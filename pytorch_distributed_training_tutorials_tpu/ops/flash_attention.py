@@ -167,7 +167,7 @@ def _dkv_kernel(
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(qi * block_q + block_q - 1 >= kk * block_k)
+    @pl.when(_causal_overlap(qi, kk, block_q, block_k))
     def _body():
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
@@ -216,12 +216,25 @@ def _from_bhsd(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _block_sizes(s: int, block_q: int, block_k: int) -> tuple[int, int, int]:
+def _block_sizes(
+    s: int, block_q: int, block_k: int, interpret: bool
+) -> tuple[int, int, int]:
     """Clamp blocks to the (8-aligned) sequence length and compute the pad
-    that makes the padded length a multiple of both."""
+    that makes the padded length a multiple of both.
+
+    On real TPU (``interpret=False``) Mosaic requires a block's lane dim to
+    be a 128-multiple OR span the whole array, so sub-128 user block sizes
+    are rounded up (the lse/delta row tiles put block_q in lanes).
+    Interpreter mode has no tiling constraint — tests keep small blocks to
+    exercise multi-block layouts on short sequences."""
     s8 = -(-max(8, s) // 8) * 8  # sublane alignment for small sequences
-    block_q = min(block_q, s8)
-    block_k = min(block_k, s8)
+
+    def clamp(b: int) -> int:
+        if not interpret:
+            b = -(-b // 128) * 128
+        return s8 if b >= s8 else b
+
+    block_q, block_k = clamp(block_q), clamp(block_k)
     target = -(-s // block_q) * block_q
     target = -(-target // block_k) * block_k
     return block_q, block_k, target - s
@@ -230,7 +243,7 @@ def _block_sizes(s: int, block_q: int, block_k: int) -> tuple[int, int, int]:
 def _fwd_impl(q, k, v, block_q, block_k, interpret):
     b, s, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    block_q, block_k, pad = _block_sizes(s, block_q, block_k)
+    block_q, block_k, pad = _block_sizes(s, block_q, block_k, interpret)
     qf, kf, vf = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
     if pad:
         # zero-padded tail keys sit above every real row's diagonal -> the
@@ -325,7 +338,7 @@ def _flash_bwd(block_q, block_k, interpret, res, g):
     qf, kf, vf, out, lse, qshape = res
     b, s, h, d = qshape
     bh, sp, _ = qf.shape
-    block_q, block_k, _ = _block_sizes(s, block_q, block_k)
+    block_q, block_k, _ = _block_sizes(s, block_q, block_k, interpret)
     scale = 1.0 / (d ** 0.5)
     n_q, n_k = sp // block_q, sp // block_k
 

@@ -145,4 +145,7 @@ def make_ring_attention(
         out = o / l[..., None]
         return out.transpose(0, 2, 1, 3).astype(qb.dtype)
 
+    # generate()'s prefill checks this: ring needs S to divide the seq axis,
+    # so arbitrary-length prompts prefill via the dense-equivalent path
+    ring_attention.requires_seq_divisible = True
     return ring_attention

@@ -100,4 +100,7 @@ def make_ulysses_attention(
             out, seq_axis, split_axis=1, concat_axis=2, tiled=True
         )
 
+    # generate()'s prefill checks this: Ulysses needs S to divide the seq
+    # axis, so arbitrary-length prompts prefill via the dense path
+    ulysses_attention.requires_seq_divisible = True
     return ulysses_attention

@@ -431,7 +431,11 @@ class Trainer:
                     f"global batch ({train_loader.global_batch}) not "
                     f"divisible by grad_accum_steps ({grad_accum_steps})"
                 )
-            d = self.strategy.num_devices
+            # data-axis width from the LOADER's batch sharding — loaders
+            # expose it as .world (loader.py); strategy.num_devices is each
+            # strategy's data width by contract, but on hybrid meshes the
+            # loader is the ground truth (ADVICE r3)
+            d = getattr(train_loader, "world", self.strategy.num_devices)
             per_dev = train_loader.global_batch // max(d, 1)
             if per_dev % grad_accum_steps:
                 # semantically correct either way (microbatches are the same
@@ -606,10 +610,17 @@ class Trainer:
         t0 = time.perf_counter()
         losses = []
         steps = 0
+        next_log = self.log_every or 0
         for chunk in loader.iter_chunks():
             steps += jax.tree_util.tree_leaves(chunk)[0].shape[0]
             self.state, chunk_losses = self._chunk_scan(self.state, chunk)
             losses.append(chunk_losses)
+            if self.log_every and steps >= next_log:
+                # per-chunk granularity (a chunk is one compiled launch;
+                # per-step logs would force a D2H sync into the scan) —
+                # costs one loss fetch, so only when log_every opted in
+                log0(f"  step {steps}: loss {float(chunk_losses[-1]):.4f}")
+                next_log = steps + self.log_every
         self.last_epoch_losses = losses[-1] if losses else None
         if self.defer_host_fetch:
             # completion sync only — no D2H (see defer_host_fetch in

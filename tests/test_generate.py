@@ -174,3 +174,25 @@ def test_repeated_calls_reuse_compiled_program():
     generate(model, params, prompt, 4)
     info = _compiled_generate.cache_info()
     assert info.misses == 1 and info.hits == 1
+
+
+def test_generate_with_ring_attention_any_prompt_length():
+    """An SP-configured model (ring attention_fn) must generate for ANY
+    prompt length: prefill falls back to the dense causal path (equivalent
+    math), so the seq-axis divisibility constraint of the ring schedule
+    does not apply to prompts (ADVICE r3)."""
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+    from pytorch_distributed_training_tutorials_tpu.parallel.ring_attention import (
+        make_ring_attention,
+    )
+
+    mesh = create_mesh({"seq": 4})
+    model, params = _model(attention_fn=make_ring_attention(mesh))
+    dense_model, _ = _model()
+    rng = np.random.Generator(np.random.PCG64(3))
+    # 5 does not divide the 4-wide seq axis — pre-fix this failed in the
+    # shard_map sharding check
+    prompt = jnp.asarray(rng.integers(0, 32, (2, 5)), jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=4)
+    ref = _oracle_greedy(dense_model, params, prompt, 4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
