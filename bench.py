@@ -16,7 +16,7 @@ costing ~8% on the tunneled runtime; the step itself is ~85% convolution
 fusions — see PROFILE_r04.md for the HLO-verified breakdown that corrected
 round 2's "BN-bound" misread). The JSON line carries the honesty
 metadata: whether the data was a synthetic surrogate (no network egress in
-the build env), a breakdown (streaming input pipeline alone, train step
+the build env), a breakdown (streaming train, raw H2D ceiling, train step
 alone), and the held-out eval accuracy against the stated 0.99 target (the
 BASELINE "reaches reference accuracy" demonstration, measured unbiased —
 wrap-padding masked).
@@ -83,8 +83,9 @@ def main() -> None:
         # larger-than-HBM dataset actually takes: chunked H2D (16 steps per
         # transfer), background prefetch, each chunk trained as one scanned
         # launch (data/streaming.py). Ceiling on this host: the tunnel's
-        # true H2D bandwidth (~8-16 MB/s ≈ 10-20k img/s of uint8 MNIST);
-        # on real PCIe hosts the step rate (~36 MB/s needed) is the bound.
+        # true H2D bandwidth (drifts 2.5-11 MB/s minute to minute — leg 1b
+        # measures it in the same window); on real PCIe hosts the step
+        # rate (~36 MB/s needed) would be the bound instead.
         chunked = ChunkedStreamingLoader(
             ds, per_device_batch, mesh, seed=0,
             steps_per_chunk=16, transform=loader.transform,
@@ -93,26 +94,69 @@ def main() -> None:
             model, chunked, optax.sgd(0.05, momentum=0.9),
             loss="cross_entropy",
         )
+        # Breakdown leg 1: streaming train vs the RAW H2D ceiling. The
+        # ceiling is pure device_put of the same dataset bytes in
+        # chunk-sized buffers, primed and closed by a ONE-element terminal
+        # fetch. The tunnel's bandwidth drifts minute to minute (observed
+        # 2.5-11 MB/s across a day), so the ceiling is measured
+        # immediately BEFORE and AFTER the streaming epoch and averaged —
+        # bracketing the drift instead of racing it. Round-4 finding:
+        # streaming training runs at ~100% of the same-window ceiling
+        # (4,787 img/s train vs 4,728 img/s raw put, same process) — the
+        # gap to the step-only rate is tunnel physics, not pipeline
+        # overhead. (Round 3's 'pipeline-only slower than
+        # pipeline+training' inversion was this drift plus per-chunk
+        # syncs in the old pipeline-only leg.)
+        import numpy as np
+
+        n_bufs = 7
+        rows_needed = chunked.steps_per_chunk * chunked.global_batch
+        # np.resize wraps when the dataset has fewer rows than one chunk
+        # needs (16 * 512 * n_chips can exceed 60000 on multi-chip hosts)
+        chunk_imgs = np.resize(
+            ds.arrays[0], (rows_needed, *ds.arrays[0].shape[1:])
+        ).reshape(
+            chunked.steps_per_chunk, chunked.global_batch,
+            *ds.arrays[0].shape[1:]
+        )
+
+        def fetch_scalar(buf):
+            # device-side index, then a ONE-element D2H — fetching the
+            # whole buffer would charge MBs of D2H to the H2D timing
+            return float(buf[-1, -1].ravel()[-1])
+
+        def h2d_leg():
+            t0 = time.perf_counter()
+            bufs = [jax.device_put(chunk_imgs) for _ in range(n_bufs)]
+            jax.block_until_ready(bufs)
+            fetch_scalar(bufs[-1])
+            return time.perf_counter() - t0
+
+        # warm + prime the put path (first-fetch stall lives elsewhere but
+        # the first put of a new shape pays layout/allocator setup)
+        bufs = [jax.device_put(chunk_imgs) for _ in range(2)]
+        jax.block_until_ready(bufs)
+        fetch_scalar(bufs[-1])
+        del bufs
+
         # compiles both chunk lengths AND primes the first-fetch stall
-        # (the per-epoch loss fetch) outside the timed region
+        # (the per-epoch loss fetch) outside the timed region — and
+        # outside the bracket: epoch 0's compile takes long enough for
+        # the tunnel to drift
         stream_trainer._run_epoch(0)
+        dt_before = h2d_leg()
         stream_train_images_s = stream_trainer._run_epoch(1)[
             "samples_per_sec"
         ]
-
-        # Breakdown leg 1b: the input pipeline alone (native C++ row gather
-        # + chunked H2D + prefetch), no compute — one full pass, closed by
-        # a real fetch of the last chunk's bytes
-        t0 = time.perf_counter()
-        n_steps = 0
-        chunk = None
-        for chunk in chunked.iter_chunks():
-            jax.block_until_ready(chunk)
-            n_steps += chunk[0].shape[0]
-        if chunk is not None:  # terminal fetch: close the async pipeline
-            float(chunk[1][-1, -1])
-        input_images_s = n_steps * chunked.global_batch / (
-            time.perf_counter() - t0
+        dt_after = h2d_leg()
+        dt = (dt_before + dt_after) / 2
+        # how much the tunnel moved across the bracket: ~1.0 = stable
+        # window (the fraction below is trustworthy); >>1 = the fraction
+        # is drift noise around the controlled same-process finding (~1.0)
+        h2d_drift = max(dt_before, dt_after) / min(dt_before, dt_after)
+        h2d_mb_s = n_bufs * chunk_imgs.nbytes / 1e6 / dt
+        h2d_images_s = (
+            n_bufs * chunked.steps_per_chunk * chunked.global_batch / dt
         )
 
         # Headline: epoch 0 compiles the per-epoch program; the first fused
@@ -145,12 +189,16 @@ def main() -> None:
         state = trainer.state
         state, losses = chain(state)  # compile
         jax.block_until_ready(losses)
-        t0 = time.perf_counter()
-        state, losses = chain(state)
-        float(losses[-1])
-        step_images_s = (
-            chain_len * loader.global_batch / (time.perf_counter() - t0)
-        )
+        # min-of-2: the tunnel suffers rare multi-tens-of-seconds stalls
+        # (observed once in ~6 runs: a 2.6 s chain read as 108 s); the
+        # minimum of two closed timed regions rejects a one-off stall
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            state, losses = chain(state)
+            float(losses[-1])
+            best = min(best, time.perf_counter() - t0)
+        step_images_s = chain_len * loader.global_batch / best
 
         # Accuracy demonstration (BASELINE north star: "reaches reference
         # accuracy"): evaluate on the held-out test split with wrap-padding
@@ -195,12 +243,16 @@ def main() -> None:
                     "streaming_train_images_per_sec_per_chip": round(
                         stream_train_images_s / n_chips, 1
                     ),
-                    # renamed from input_pipeline_... in round 3: this leg
-                    # now measures the CHUNKED+prefetched pipeline (the one
-                    # training actually uses), not round 2's per-batch
-                    # ShardedLoader H2D — not comparable across that change
-                    "chunked_input_pipeline_images_per_sec_per_chip": round(
-                        input_images_s / n_chips, 1
+                    # round 4: the pipeline-alone leg became the RAW H2D
+                    # ceiling (pure device_put, same bytes, same tunnel
+                    # window) — streaming is judged as a fraction of it
+                    "h2d_ceiling_images_per_sec_per_chip": round(
+                        h2d_images_s / n_chips, 1
+                    ),
+                    "h2d_ceiling_mb_per_sec": round(h2d_mb_s, 2),
+                    "h2d_window_drift": round(h2d_drift, 2),
+                    "streaming_fraction_of_h2d_ceiling": round(
+                        stream_train_images_s / max(h2d_images_s, 1e-9), 3
                     ),
                     "train_step_only_images_per_sec_per_chip": round(
                         step_images_s / n_chips, 1

@@ -252,15 +252,16 @@ class Attention(nn.Module):
                 if cfg.attention_fn is not None
                 else causal_attention
             )
-            if prefill and getattr(attn, "requires_seq_divisible", False):
+            div = getattr(attn, "requires_seq_divisible", 0)
+            if prefill and div and x.shape[1] % div:
                 # sequence-parallel schedules (ring/Ulysses) require the
-                # sequence to divide the seq mesh axis, which arbitrary
-                # prompt lengths don't satisfy — prefill falls back to the
-                # causal-equivalent dense path for THOSE fns only (flagged
-                # via requires_seq_divisible; the cache contents, raw K/V,
-                # are attention-independent either way). Other custom fns
-                # (e.g. the Pallas flash kernel) handle any length and keep
-                # their memory advantages during prefill. (ADVICE r3)
+                # sequence to divide the seq mesh axis; for prompt lengths
+                # that don't, prefill falls back to the causal-equivalent
+                # dense path (the cache contents, raw K/V, are
+                # attention-independent either way). Divisible prompts —
+                # the long-context case SP exists for — keep the SP
+                # schedule and its memory bound; other custom fns (e.g.
+                # the Pallas flash kernel) handle any length. (ADVICE r3)
                 attn = causal_attention
             out = attn(q, k, v)
         return out_proj(out)

@@ -36,6 +36,9 @@ class DataParallel:
 
     @property
     def num_devices(self) -> int:
+        # INTERFACE CONTRACT (all strategies): the DATA-axis width — how
+        # many ways the batch's dim 0 is sharded — NOT the total device
+        # count. Trainer's grad-accum divisibility math relies on this.
         return self.mesh.shape.get(self.axis, 1)
 
     def variable_shardings(self, abstract_variables):

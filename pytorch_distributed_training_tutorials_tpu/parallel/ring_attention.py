@@ -145,7 +145,8 @@ def make_ring_attention(
         out = o / l[..., None]
         return out.transpose(0, 2, 1, 3).astype(qb.dtype)
 
-    # generate()'s prefill checks this: ring needs S to divide the seq axis,
-    # so arbitrary-length prompts prefill via the dense-equivalent path
-    ring_attention.requires_seq_divisible = True
+    # generate()'s prefill checks this: ring needs S to divide the seq
+    # axis, so non-divisible prompt lengths prefill via the dense path
+    # (divisible ones keep the ring and its memory bound)
+    ring_attention.requires_seq_divisible = n
     return ring_attention

@@ -101,6 +101,6 @@ def make_ulysses_attention(
         )
 
     # generate()'s prefill checks this: Ulysses needs S to divide the seq
-    # axis, so arbitrary-length prompts prefill via the dense path
-    ulysses_attention.requires_seq_divisible = True
+    # axis, so non-divisible prompt lengths prefill via the dense path
+    ulysses_attention.requires_seq_divisible = n
     return ulysses_attention

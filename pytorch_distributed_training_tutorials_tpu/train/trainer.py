@@ -431,11 +431,11 @@ class Trainer:
                     f"global batch ({train_loader.global_batch}) not "
                     f"divisible by grad_accum_steps ({grad_accum_steps})"
                 )
-            # data-axis width from the LOADER's batch sharding — loaders
-            # expose it as .world (loader.py); strategy.num_devices is each
-            # strategy's data width by contract, but on hybrid meshes the
-            # loader is the ground truth (ADVICE r3)
-            d = getattr(train_loader, "world", self.strategy.num_devices)
+            # strategy.num_devices is the DATA-axis width by interface
+            # contract (every strategy returns mesh.shape[data axis], not
+            # the total device count — see DataParallel.num_devices), so
+            # it is the right divisor on hybrid meshes too (ADVICE r3)
+            d = self.strategy.num_devices
             per_dev = train_loader.global_batch // max(d, 1)
             if per_dev % grad_accum_steps:
                 # semantically correct either way (microbatches are the same
