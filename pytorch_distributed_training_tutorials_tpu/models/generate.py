@@ -126,5 +126,33 @@ def generate(
     tokens0 = jnp.concatenate(
         [prompt, jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1
     )
+    model = _window_model(model, total)
     run = _compiled_generate(model, p_len, total, float(temperature))
     return run(params, tokens0, rng)
+
+
+def _window_model(model, total: int):
+    """Serve with a cache sized to the REQUEST, not the model maximum.
+
+    The KV cache (and therefore every decode step's attention window and
+    cache-update traffic) is shaped by ``cfg.max_seq_len``; a 32+32-token
+    request against a ``max_seq_len=512`` model would pay 8x the cache
+    reads per step for positions that are provably empty. Rebuild the
+    module with ``max_seq_len`` = ``total`` (8-aligned for TPU sublanes).
+    Params are cache-shape-independent, so the same weights serve any
+    window; ``dataclasses.replace`` on the module preserves every other
+    field (flax modules are dataclasses). Falls back to the original
+    model for custom module types without a replaceable dataclass ``cfg``.
+    """
+    import dataclasses
+
+    cfg = model.cfg
+    window = min(cfg.max_seq_len, -(-total // 8) * 8)
+    if window == cfg.max_seq_len:
+        return model
+    try:
+        return dataclasses.replace(
+            model, cfg=dataclasses.replace(cfg, max_seq_len=window)
+        )
+    except TypeError:
+        return model

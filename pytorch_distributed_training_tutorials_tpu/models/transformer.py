@@ -56,6 +56,9 @@ class TransformerConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # tokens per MoE routing/capacity group (None = one S-token group;
+    # see models/moe.py's memory-ceiling note — set for long sequences)
+    moe_group_size: int | None = None
     # int8 serving: every matmul weight becomes an Int8Dense(General) over
     # the Pallas MXU kernel (the load_in_8bit twin, SURVEY C13). Params come
     # from quantize_lm_params(f32_params) or load_quantized_lm(path);
@@ -306,6 +309,7 @@ class Block(nn.Module):
                 d_ff=cfg.ff_dim,
                 capacity_factor=cfg.moe_capacity_factor,
                 dtype=cfg.dtype,
+                group_size=cfg.moe_group_size,
                 name="moe",
             )
         else:

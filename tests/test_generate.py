@@ -196,3 +196,19 @@ def test_generate_with_ring_attention_any_prompt_length():
     out = generate(model, params, prompt, max_new_tokens=4)
     ref = _oracle_greedy(dense_model, params, prompt, 4)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_request_sized_cache_window_matches_full():
+    """generate() rebuilds the module with a request-sized KV cache when
+    total << max_seq_len (_window_model); the windowed serve must be
+    token-identical to the full-cache model and preserve non-cfg module
+    fields (dataclasses.replace on the module, not type(model)(cfg))."""
+    model, params = _model(max_seq_len=256)
+    rng = np.random.Generator(np.random.PCG64(11))
+    prompt = jnp.asarray(rng.integers(0, 32, (2, 6)), jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=6)
+    ref = _oracle_greedy(model, params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # the rebuild branch actually ran (window 16 < 256)
+    from pytorch_distributed_training_tutorials_tpu.models.generate import _window_model
+    assert _window_model(model, 12).cfg.max_seq_len == 16

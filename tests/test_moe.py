@@ -152,3 +152,41 @@ def test_ep_matches_single_device():
     m_ep = t_ep._run_epoch(0)
     m_1 = t_1._run_epoch(0)
     np.testing.assert_allclose(m_ep["loss"], m_1["loss"], rtol=2e-4)
+
+
+def test_grouped_dispatch_matches_ungrouped():
+    """With capacity headroom (no dropped tokens), group_size is pure
+    memory layout: outputs are identical to the ungrouped dispatch."""
+    d, e, s = 16, 4, 64
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, s, d))
+    dense = MoEFFN(num_experts=e, top_k=2, capacity_factor=float(e))
+    grouped = MoEFFN(
+        num_experts=e, top_k=2, capacity_factor=float(e), group_size=16
+    )
+    params = dense.init(jax.random.PRNGKey(1), x)
+    out_d, _ = dense.apply(params, x, mutable=["losses"])
+    out_g, _ = grouped.apply(params, x, mutable=["losses"])
+    np.testing.assert_allclose(
+        np.asarray(out_d), np.asarray(out_g), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_dispatch_memory_curve_pinned():
+    """The dense dispatch is ~B*S^2*k*f floats (quadratic in S); token
+    groups cut it to ~B*S*group_size*k*f. Pin both: compiled temp memory
+    at S=1024 must shrink by ~the group count when group_size=128."""
+    d, e, s = 8, 4, 1024
+    x = jnp.zeros((1, s, d))
+    temps = {}
+    for gs in (None, 128):
+        m = MoEFFN(num_experts=e, top_k=2, group_size=gs)
+        params = m.init(jax.random.PRNGKey(0), x)
+        fwd = jax.jit(lambda p, x, m=m: m.apply(p, x, mutable=["losses"]))
+        temps[gs] = (
+            fwd.lower(params, x).compile().memory_analysis()
+            .temp_size_in_bytes
+        )
+    # dispatch+combine at S=1024: cap=640 -> (1,1024,4,640) f32 ~ 10.5 MB
+    # each; grouped (gs=128, cap=80): 8 groups x (1,128,4,80) ~ 0.16 MB.
+    # Compiled temps include other buffers, so assert a conservative 4x.
+    assert temps[128] * 4 < temps[None], temps
