@@ -188,6 +188,18 @@ def main():
             f"in {time.perf_counter()-t0:.0f}s, peak RSS {rss_gb():.1f} GB"
         )
     else:
+        # reuse: still report the checkpoint facts (schema-derived, cheap)
+        abstract = jax.eval_shape(
+            TransformerLM(cfg).init, jax.random.PRNGKey(0),
+            jnp.zeros((1, 4), jnp.int32),
+        )["params"]
+        n_params = sum(
+            int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(abstract)
+        )
+        receipt["n_params"] = n_params
+        receipt["checkpoint_gb_f32"] = round(4 * n_params / 1e9, 2)
+        receipt["checkpoint_reused"] = True
         print(f"checkpoint: reusing {ckpt}")
 
     rss_before = rss_gb()

@@ -645,9 +645,62 @@ for p in points:
           f"img/s/chip, efficiency {p.efficiency:.2f}")
 """),
     ("md", """
+## Long context — the same attention contract, three executions
+
+Dense causal attention materializes a `(B, H, S, S)` float32 score tensor
+— quadratic HBM that caps single-chip context. Two escapes, both drop-in
+`attention_fn`s for the same `TransformerLM`:
+
+- **Pallas flash attention** (`ops.flash_attention`): blockwise online
+  softmax — scores only ever exist as VMEM tiles, temp memory flat in S
+  (`FLASH_r04.md` has the v5e evidence: ~2x faster training at S=4096,
+  2.1 GB of dense temps avoided).
+- **Ring attention** (`parallel.ring_attention`): shard the *sequence*
+  over a mesh axis; K/V blocks rotate via `ppermute` while each device
+  folds them into the same online-softmax state — context length scales
+  linearly with the ring size.
+
+They must agree with the dense reference exactly — one contract, three
+executions:
+"""),
+    ("code", """
+import dataclasses
+import jax, jax.numpy as jnp, numpy as np
+from pytorch_distributed_training_tutorials_tpu.models import TransformerConfig, TransformerLM
+from pytorch_distributed_training_tutorials_tpu.ops import make_flash_attention
+from pytorch_distributed_training_tutorials_tpu.parallel.ring_attention import make_ring_attention
+from pytorch_distributed_training_tutorials_tpu import create_mesh as _cm
+
+cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                        max_seq_len=64)
+toks = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 32)),
+                   jnp.int32)
+dense_lm = TransformerLM(cfg)
+variables = dense_lm.init(jax.random.PRNGKey(0), toks)
+
+flash_lm = TransformerLM(dataclasses.replace(
+    cfg, attention_fn=make_flash_attention(16, 16)))
+ring_lm = TransformerLM(dataclasses.replace(
+    cfg, attention_fn=make_ring_attention(_cm({"seq": 4}))))
+
+lg_dense = dense_lm.apply(variables, toks)
+lg_flash = flash_lm.apply(variables, toks)
+lg_ring = ring_lm.apply(variables, toks)
+print("flash vs dense:", float(jnp.abs(lg_flash - lg_dense).max()))
+print("ring  vs dense:", float(jnp.abs(lg_ring - lg_dense).max()))
+assert float(jnp.abs(lg_flash - lg_dense).max()) < 1e-4
+assert float(jnp.abs(lg_ring - lg_dense).max()) < 1e-4
+"""),
+    ("md", """
+Serving composes with the same machinery: `models.generate` prefills the
+prompt in one forward, decodes through a KV cache sized to the *request*
+(not `max_seq_len`), and an SP-configured model falls back to the dense
+path only for prompt lengths that don't divide the seq axis.
+
 Every recipe above — FSDP, both pipeline schedules, elastic restart, the
-sweep — is the *same code* on a real pod slice; only the mesh gets wider
-and the collectives move from shared-memory gloo to ICI.
+sweep, the long-context kernels — is the *same code* on a real pod slice;
+only the mesh gets wider and the collectives move from shared-memory gloo
+to ICI.
 """),
 ]
 

@@ -68,15 +68,19 @@ class MoEFFN(nn.Module):
     def __call__(self, x):
         if self.group_size is not None:
             b0, s0, d0 = x.shape
-            gs = self.group_size
+            # clamp: a group of <= S tokens degenerates to one group —
+            # keeps decode (S=1) and short prefills working on a model
+            # configured for long-sequence training
+            gs = min(self.group_size, s0)
             if s0 % gs:
                 raise ValueError(
                     f"sequence length {s0} not divisible by "
                     f"group_size {gs}"
                 )
-            xg = x.reshape(b0 * (s0 // gs), gs, d0)
-            out = self._moe(xg)
-            return out.reshape(b0, s0, d0)
+            if gs < s0:
+                xg = x.reshape(b0 * (s0 // gs), gs, d0)
+                out = self._moe(xg)
+                return out.reshape(b0, s0, d0)
         return self._moe(x)
 
     def _moe(self, x):

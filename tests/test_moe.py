@@ -190,3 +190,23 @@ def test_dispatch_memory_curve_pinned():
     # each; grouped (gs=128, cap=80): 8 groups x (1,128,4,80) ~ 0.16 MB.
     # Compiled temps include other buffers, so assert a conservative 4x.
     assert temps[128] * 4 < temps[None], temps
+
+
+def test_grouped_moe_decodes():
+    """group_size must clamp for decode (S=1) and short prefills — a
+    grouped-MoE model has to generate (review r4)."""
+    from pytorch_distributed_training_tutorials_tpu.models import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from pytorch_distributed_training_tutorials_tpu.models.generate import generate
+
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=32, n_layers=1, n_heads=2, max_seq_len=32,
+        moe_experts=4, moe_top_k=2, moe_group_size=8,
+    )
+    model = TransformerLM(cfg)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    out = generate(model, params, tokens, max_new_tokens=3)
+    assert out.shape == (1, 7)
