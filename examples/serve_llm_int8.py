@@ -63,6 +63,24 @@ def rss_gb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
 
+def count_params(cfg) -> int:
+    """Schema-derived param count (no weights materialized) — the one
+    definition shared by the checkpoint writer and the reuse receipt."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tutorials_tpu.models import TransformerLM
+
+    abstract = jax.eval_shape(
+        TransformerLM(cfg).init, jax.random.PRNGKey(0),
+        jnp.zeros((1, 4), jnp.int32),
+    )["params"]
+    return sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(abstract)
+    )
+
+
 def write_synthetic_checkpoint(cfg, path: str, seed: int = 0) -> int:
     """Materialize a random-init f32 checkpoint WITHOUT ever holding the
     full model: each top-level param subtree (one block ~67M params at the
@@ -84,9 +102,7 @@ def write_synthetic_checkpoint(cfg, path: str, seed: int = 0) -> int:
     abstract = jax.eval_shape(
         model.init, jax.random.PRNGKey(seed), jnp.zeros((1, 4), jnp.int32)
     )["params"]
-    total = sum(
-        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(abstract)
-    )
+    total = count_params(cfg)
 
     # init one top-level subtree at a time: eval_shape gives the schema,
     # real PRNG init would need the whole model — random normals at the
@@ -189,14 +205,7 @@ def main():
         )
     else:
         # reuse: still report the checkpoint facts (schema-derived, cheap)
-        abstract = jax.eval_shape(
-            TransformerLM(cfg).init, jax.random.PRNGKey(0),
-            jnp.zeros((1, 4), jnp.int32),
-        )["params"]
-        n_params = sum(
-            int(np.prod(l.shape))
-            for l in jax.tree_util.tree_leaves(abstract)
-        )
+        n_params = count_params(cfg)
         receipt["n_params"] = n_params
         receipt["checkpoint_gb_f32"] = round(4 * n_params / 1e9, 2)
         receipt["checkpoint_reused"] = True

@@ -69,14 +69,15 @@ class MoEFFN(nn.Module):
         if self.group_size is not None:
             b0, s0, d0 = x.shape
             # clamp: a group of <= S tokens degenerates to one group —
-            # keeps decode (S=1) and short prefills working on a model
-            # configured for long-sequence training
+            # keeps decode (S=1) working on a model configured for
+            # long-sequence training. Non-divisible lengths (odd prefill
+            # prompts) also fall back to ONE group: same routing, whole-
+            # sequence capacity — the ungrouped semantics, never a crash
+            # (capacity-pressure behavior can differ from grouped
+            # training; inference prompts rarely hit capacity)
             gs = min(self.group_size, s0)
             if s0 % gs:
-                raise ValueError(
-                    f"sequence length {s0} not divisible by "
-                    f"group_size {gs}"
-                )
+                gs = s0
             if gs < s0:
                 xg = x.reshape(b0 * (s0 // gs), gs, d0)
                 out = self._moe(xg)

@@ -56,24 +56,15 @@ def _qkv_spec(mesh: Mesh, data_axis: str, seq_axis: str, model_axis: str) -> P:
     )
 
 
-@jax.checkpoint
-def _ring_hop(qb, k_t, v_t, o, l, m, q_pos, k_pos, scale):
-    """One ring hop: fold an incoming K/V block into the online-softmax
-    state ``(o, l, m)``.
-
-    ``jax.checkpoint`` here is what makes the module's O((S/n)^2) memory
-    claim true *through backward*: without it, ``jax.grad`` over the
-    unrolled ring stores every hop's (b, h, s_blk, s_blk) probability
-    block — n of them, i.e. O(S^2/n) per device, roughly the thing the
-    ring exists to avoid. Rematerialized, backward re-derives each hop's
-    scores/probabilities from its O(s_blk * d) inputs, so only one score
-    block is ever live (``tests/test_ring_attention.py`` pins the residual
-    footprint vs dense attention).
-    """
+def _fold_block(carry, xs, qb, q_pos, scale):
+    """Fold ONE key sub-block into the online-softmax state — the flash-
+    attention inner body, shared by every hop."""
+    o, l, m = carry
+    kb, vb, k_pos = xs
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", qb, k_t, preferred_element_type=jnp.float32
+        "bqhd,bkhd->bhqk", qb, kb, preferred_element_type=jnp.float32
     ) * scale
-    causal = q_pos[:, None] >= k_pos[None, :]  # (s_blk, s_blk) global
+    causal = q_pos[:, None] >= k_pos[None, :]  # (s_blk, blk) global
     scores = jnp.where(causal[None, None], scores, NEG_INF)
 
     m_new = jnp.maximum(m, scores.max(axis=-1))
@@ -86,9 +77,52 @@ def _ring_hop(qb, k_t, v_t, o, l, m, q_pos, k_pos, scale):
     corr = jnp.exp(m - m_new)
     l = l * corr + p.sum(axis=-1)
     o = o * corr[..., None] + jnp.einsum(
-        "bhqk,bkhd->bhqd", p, v_t.astype(jnp.float32)
+        "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32)
     )
-    return o, l, m_new
+    return (o, l, m_new), None
+
+
+@partial(jax.checkpoint, static_argnums=(9,))
+def _ring_hop(qb, k_t, v_t, o, l, m, q_pos, k_pos, scale, block=512):
+    """One ring hop: fold an incoming K/V block into the online-softmax
+    state ``(o, l, m)`` — itself BLOCKWISE (the flash decomposition), so
+    even the per-hop score tile is (s_blk, block), not (s_blk, s_blk).
+
+    Two memory properties compose here:
+
+    - ``jax.checkpoint`` on the hop makes the module's O((S/n)^2)-or-
+      better claim true *through backward*: without it, ``jax.grad`` over
+      the unrolled ring stores every hop's probability blocks — n of
+      them, i.e. O(S^2/n) per device, roughly the thing the ring exists
+      to avoid (``tests/test_ring_attention.py`` pins the residual
+      footprint vs dense attention).
+    - the inner ``lax.scan`` over ``block``-sized key sub-blocks (each
+      fold itself checkpointed) bounds LIVE memory to O(s_blk * block)
+      per device in forward and in the hop's rematerialized backward —
+      the same blockwise-online-softmax structure as the single-chip
+      Pallas kernel (``ops/flash_attention.py``), here as compiler-
+      friendly scanned jnp so XLA can still overlap the ring ppermute
+      with compute.
+    """
+    s_blk = k_t.shape[1]
+    block = min(block, s_blk)
+    if s_blk % block:
+        # ragged tails fall back to one fold over the whole hop block
+        block = s_blk
+    nb = s_blk // block
+
+    def to_blocks(a):  # (b, s_blk, h, d) -> (nb, b, block, h, d)
+        return a.reshape(
+            a.shape[0], nb, block, *a.shape[2:]
+        ).swapaxes(0, 1)
+
+    xs = (to_blocks(k_t), to_blocks(v_t), k_pos.reshape(nb, block))
+    fold = jax.checkpoint(
+        lambda c, x: _fold_block(c, x, qb, q_pos, scale),
+        prevent_cse=False,
+    )
+    (o, l, m), _ = jax.lax.scan(fold, (o, l, m), xs)
+    return o, l, m
 
 
 def make_ring_attention(
@@ -97,6 +131,7 @@ def make_ring_attention(
     seq_axis: str = SEQ_AXIS,
     data_axis: str = DATA_AXIS,
     model_axis: str = MODEL_AXIS,
+    hop_block: int = 512,
 ):
     """Build a causal ``attention_fn(q, k, v) -> out`` ((B, S, H, D) each)
     that computes attention sequence-parallel over ``mesh[seq_axis]``.
@@ -104,7 +139,9 @@ def make_ring_attention(
     Numerically equivalent to :func:`..models.transformer.causal_attention`
     (verified to float tolerance in ``tests/test_ring_attention.py``); the
     difference is where the bytes live: no device ever materializes the full
-    (S, S) score matrix or the full K/V.
+    (S, S) score matrix or the full K/V. ``hop_block`` bounds the per-hop
+    score tile (see :func:`_ring_hop`): live score memory is
+    O(s_blk * hop_block) per device, forward and backward.
     """
     if seq_axis not in mesh.shape:
         raise ValueError(f"mesh has no {seq_axis!r} axis: {dict(mesh.shape)}")
@@ -125,7 +162,17 @@ def make_ring_attention(
         scale = 1.0 / jnp.sqrt(jnp.float32(d))
         o = jnp.zeros((b, h, s_blk, d), jnp.float32)
         l = jnp.zeros((b, h, s_blk), jnp.float32)
-        m = jnp.full((b, h, s_blk), NEG_INF)
+        # strong f32 (a weak-typed full() would flip type across the
+        # blockwise scan carry)
+        m = jnp.full((b, h, s_blk), NEG_INF, jnp.float32)
+        # the hop's inner scan requires carry types stable across
+        # iterations, including the varying-manual-axis tags the folded
+        # (sharded) K/V blocks impart — mark the fresh state varying over
+        # every mesh axis up front (the fold output's tag is the union of
+        # the carry's and the sharded operands')
+        o, l, m = jax.lax.pcast(
+            (o, l, m), tuple(mesh.axis_names), to="varying"
+        )
 
         k_t, v_t = kb, vb
         shift = [(j, (j + 1) % n) for j in range(n)]
@@ -134,7 +181,7 @@ def make_ring_attention(
             src = (idx - t) % n
             k_pos = src * s_blk + jnp.arange(s_blk)
             o, l, m = _ring_hop(
-                qb, k_t, v_t, o, l, m, q_pos, k_pos, scale
+                qb, k_t, v_t, o, l, m, q_pos, k_pos, scale, hop_block
             )
             if t < n - 1:
                 k_t, v_t = jax.lax.ppermute(

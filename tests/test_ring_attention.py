@@ -88,7 +88,9 @@ def test_transformer_logits_identical_with_ring():
 def _residual_bytes(f, *args):
     """Total bytes of the residuals jax.vjp stores for f's backward (the
     arrays closed over by the returned vjp function)."""
-    _, vjp_fn = jax.vjp(f, *args)
+    # jit: the blockwise hop's inner checkpoint (closed_call) cannot be
+    # evaluated eagerly inside shard_map
+    _, vjp_fn = jax.vjp(jax.jit(f), *args)
     return sum(
         x.size * x.dtype.itemsize
         for x in jax.tree_util.tree_leaves(vjp_fn)
@@ -146,3 +148,31 @@ def test_sp_training_end_to_end():
     first = trainer._run_epoch(0)
     last = trainer.train(3)
     assert last["loss"] < first["loss"]
+
+
+def test_hop_block_bounds_temp_memory():
+    """The blockwise hop (flash-structured inner scan) must bound the
+    compiled backward's TEMP memory: a small hop_block cannot cost more
+    than the whole-hop score tile, and shrinks live score memory
+    O(s_blk^2) -> O(s_blk * hop_block)."""
+    mesh = create_mesh({"seq": 2})
+    s = 512  # s_blk = 256 per device
+    q, k, v = _qkv(b=1, s=s, h=2, d=16)
+    temps = {}
+    for blk in (256, 32):
+        ring = make_ring_attention(mesh, hop_block=blk)
+        g = jax.jit(
+            jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v)), argnums=0)
+        )
+        temps[blk] = (
+            g.lower(q, k, v).compile().memory_analysis().temp_size_in_bytes
+        )
+        # and the numerics are block-size independent
+    out_small = make_ring_attention(mesh, hop_block=32)(q, k, v)
+    out_full = make_ring_attention(mesh, hop_block=256)(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_small), np.asarray(out_full), rtol=1e-5, atol=1e-5
+    )
+    # strict: measured ~1.1 MB vs ~3.4 MB on the CPU mesh — a no-op inner
+    # scan (block silently clamped to s_blk) would fail this
+    assert temps[32] * 2 < temps[256], temps
