@@ -63,19 +63,21 @@ def rss_gb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
 
-def count_params(cfg) -> int:
+def count_params(cfg, abstract=None) -> int:
     """Schema-derived param count (no weights materialized) — the one
-    definition shared by the checkpoint writer and the reuse receipt."""
+    definition shared by the checkpoint writer and the reuse receipt.
+    Pass ``abstract`` (an eval_shape params tree) to skip re-tracing."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from pytorch_distributed_training_tutorials_tpu.models import TransformerLM
 
-    abstract = jax.eval_shape(
-        TransformerLM(cfg).init, jax.random.PRNGKey(0),
-        jnp.zeros((1, 4), jnp.int32),
-    )["params"]
+    if abstract is None:
+        abstract = jax.eval_shape(
+            TransformerLM(cfg).init, jax.random.PRNGKey(0),
+            jnp.zeros((1, 4), jnp.int32),
+        )["params"]
     return sum(
         int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(abstract)
     )
@@ -102,7 +104,7 @@ def write_synthetic_checkpoint(cfg, path: str, seed: int = 0) -> int:
     abstract = jax.eval_shape(
         model.init, jax.random.PRNGKey(seed), jnp.zeros((1, 4), jnp.int32)
     )["params"]
-    total = count_params(cfg)
+    total = count_params(cfg, abstract)
 
     # init one top-level subtree at a time: eval_shape gives the schema,
     # real PRNG init would need the whole model — random normals at the

@@ -70,21 +70,28 @@ class MoEFFN(nn.Module):
             b0, s0, d0 = x.shape
             # clamp: a group of <= S tokens degenerates to one group —
             # keeps decode (S=1) working on a model configured for
-            # long-sequence training. Non-divisible lengths (odd prefill
-            # prompts) also fall back to ONE group: same routing, whole-
-            # sequence capacity — the ungrouped semantics, never a crash
-            # (capacity-pressure behavior can differ from grouped
-            # training; inference prompts rarely hit capacity)
+            # long-sequence training
             gs = min(self.group_size, s0)
-            if s0 % gs:
-                gs = s0
-            if gs < s0:
-                xg = x.reshape(b0 * (s0 // gs), gs, d0)
-                out = self._moe(xg)
-                return out.reshape(b0, s0, d0)
+            pad = (-s0) % gs
+            if pad:
+                # non-divisible lengths (odd prefill prompts) PAD the tail
+                # group rather than collapsing to one group — collapsing
+                # would reintroduce the O(S^2) dispatch the grouping
+                # exists to bound. Pad tokens are masked out of routing
+                # (they take no capacity slots and contribute nothing).
+                x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+            sp = s0 + pad
+            if gs < sp or pad:
+                valid = (
+                    jnp.arange(sp, dtype=jnp.float32) < s0
+                )[None, :].repeat(b0, axis=0)
+                xg = x.reshape(b0 * (sp // gs), gs, d0)
+                vg = valid.reshape(b0 * (sp // gs), gs)
+                out = self._moe(xg, vg)
+                return out.reshape(b0, sp, d0)[:, :s0]
         return self._moe(x)
 
-    def _moe(self, x):
+    def _moe(self, x, valid=None):
         b, s, d = x.shape
         e, k = self.num_experts, self.top_k
         ff = self.d_ff if self.d_ff is not None else 4 * d
@@ -99,12 +106,16 @@ class MoEFFN(nn.Module):
             jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router), axis=-1
         )
 
-        # greedy top-k: k passes of argmax, each masking its pick
+        # greedy top-k: k passes of argmax, each masking its pick. Padded
+        # rows (valid == 0) are excluded from routing entirely — they hold
+        # no capacity slots and their combine weights are zero.
         g = gates
         picks, weights = [], []
         for _ in range(k):
             idx = jnp.argmax(g, axis=-1)
             onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (B,S,E)
+            if valid is not None:
+                onehot = onehot * valid[..., None]
             picks.append(onehot)
             weights.append(jnp.sum(g * onehot, axis=-1))  # (B,S)
             g = g * (1.0 - onehot)

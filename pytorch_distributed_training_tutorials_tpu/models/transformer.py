@@ -43,6 +43,12 @@ class TransformerConfig:
     n_layers: int = 2
     n_heads: int = 4
     d_ff: int | None = None  # default 4 * d_model
+    # grouped-query attention (GQA; 1 = MQA): K/V are projected to this
+    # many heads and the KV cache stores only them — n_heads/n_kv_heads
+    # query heads share each KV head (repeated at attention time). None =
+    # n_heads (standard MHA). Serving win: cache bytes scale with
+    # n_kv_heads (Llama-2-70B-style 8x reduction at 64/8 heads).
+    n_kv_heads: int | None = None
     max_seq_len: int = 512
     dtype: jnp.dtype = jnp.float32
     rope_theta: float = 10000.0
@@ -68,8 +74,10 @@ class TransformerConfig:
     # quantized matmul through the shard_map-wrapped kernel
     # (ops.quant.int8_matmul_tp) in the Megatron column/row layout; q/scale
     # params shard per INT8_TP_RULES. Requires n_heads, ff_dim, vocab_size
-    # and d_model divisible by the model-axis size. None = single-device /
-    # replicated serving.
+    # and d_model divisible by the model-axis size (and n_kv_heads for a
+    # GQA model; a non-divisible dim falls back to replication under the
+    # float TP rules — parallel.tensor_parallel.spec_for_path drops the
+    # axis shape-aware). None = single-device / replicated serving.
     int8_mesh: "jax.sharding.Mesh | None" = None
 
     @property
@@ -80,6 +88,12 @@ class TransformerConfig:
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        assert self.n_heads % kv == 0, (self.n_heads, kv)
+        return kv
 
 
 class RMSNorm(nn.Module):
@@ -134,6 +148,40 @@ def masked_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
 
+def grouped_masked_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """GQA attention over an UN-expanded K/V: ``q`` (B, Q, H, D) against
+    ``k``/``v`` (B, L, KV, D) with H a multiple of KV — the group axis is
+    folded into the einsums, so the (GQA-shrunk) KV cache is read at its
+    stored size instead of being ``repeat``-materialized to H heads every
+    decode step. ``mask`` broadcastable to (B, 1, 1, Q, L) semantics (the
+    (1, 1, 1, L) validity row the decode path builds works unchanged).
+    Falls through to :func:`masked_attention` when H == KV."""
+    b, qlen, h, d = q.shape
+    kvh = k.shape[2]
+    if kvh == h:
+        return masked_attention(q, k, v, mask)
+    grp = h // kvh
+    q5 = q.reshape(b, qlen, kvh, grp, d)
+    scores = jnp.einsum(
+        "bqcgd,blcd->bcgql", q5, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(d))
+    scores = jnp.where(mask[:, :, None], scores, jnp.float32(-1e30))
+    weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bcgql,blcd->bqcgd", weights, v)
+    return out.reshape(b, qlen, h, d)
+
+
+def _expand_kv(kv: jax.Array, n_heads: int) -> jax.Array:
+    """Repeat grouped K/V heads up to the query head count (GQA -> MHA
+    view); identity when the counts already match."""
+    reps = n_heads // kv.shape[2]
+    if reps == 1:
+        return kv
+    return jnp.repeat(kv, reps, axis=2)
+
+
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     """Dense causal softmax attention; (B, S, H, D) in and out."""
     s = q.shape[1]
@@ -147,9 +195,9 @@ class Attention(nn.Module):
     def _cache_vars(self, b: int, k_dtype, v_dtype):
         """The one copy of the KV-cache schema shared by the decode and
         prefill branches (shapes/dtypes must agree or decode misreads what
-        prefill wrote)."""
+        prefill wrote). Only ``kv_heads`` heads are cached (GQA)."""
         cfg = self.cfg
-        h, d = cfg.n_heads, cfg.head_dim
+        h, d = cfg.kv_heads, cfg.head_dim
         cached_k = self.variable(
             "cache", "cached_key",
             jnp.zeros, (b, cfg.max_seq_len, h, d), k_dtype,
@@ -168,7 +216,7 @@ class Attention(nn.Module):
     def __call__(self, x, decode: bool = False, prefill: bool = False):
         cfg = self.cfg
         assert not (decode and prefill), "decode and prefill are exclusive"
-        h, d = cfg.n_heads, cfg.head_dim
+        h, kv, d = cfg.n_heads, cfg.kv_heads, cfg.head_dim
         if cfg.quantized:
             from pytorch_distributed_training_tutorials_tpu.ops.quant import (
                 Int8DenseGeneral,
@@ -176,8 +224,8 @@ class Attention(nn.Module):
 
             # Megatron layout: q/k/v column-split over heads, o row-split
             # (its input arrives head-sharded) with one psum per branch
-            proj = lambda name: Int8DenseGeneral(  # noqa: E731
-                (h, d), axis=-1, use_bias=False, name=name,
+            proj = lambda name, heads: Int8DenseGeneral(  # noqa: E731
+                (heads, d), axis=-1, use_bias=False, name=name,
                 mesh=cfg.int8_mesh, shard_kind="column",
             )
             out_proj = Int8DenseGeneral(
@@ -185,16 +233,17 @@ class Attention(nn.Module):
                 mesh=cfg.int8_mesh, shard_kind="row",
             )
         else:
-            proj = lambda name: nn.DenseGeneral(  # noqa: E731
-                (h, d), axis=-1, use_bias=False, dtype=cfg.dtype, name=name
+            proj = lambda name, heads: nn.DenseGeneral(  # noqa: E731
+                (heads, d), axis=-1, use_bias=False, dtype=cfg.dtype,
+                name=name,
             )
             out_proj = nn.DenseGeneral(
                 cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
                 name="o_proj",
             )
-        q_raw = proj("q_proj")(x)
-        k_raw = proj("k_proj")(x)
-        v = proj("v_proj")(x)
+        q_raw = proj("q_proj", h)(x)
+        k_raw = proj("k_proj", kv)(x)  # GQA: only kv_heads cached/projected
+        v = proj("v_proj", kv)(x)
 
         if decode:
             # incremental decoding: one token in, KV appended to the cache,
@@ -223,9 +272,11 @@ class Attention(nn.Module):
             )
             idx.value = pos + 1
             # attend over the whole cache, masking positions beyond `pos`;
-            # same math as training/prefill via the shared helper
+            # same math as training/prefill. GQA: the cache holds kv_heads
+            # and is read UN-expanded (grouped einsums) — per-step cache
+            # traffic scales with n_kv_heads, the point of the layout
             valid = jnp.arange(cfg.max_seq_len) <= pos  # (max_len,)
-            out = masked_attention(
+            out = grouped_masked_attention(
                 q, cached_k.value, cached_v.value,
                 valid[None, None, None, :],
             )
@@ -255,6 +306,11 @@ class Attention(nn.Module):
                 if cfg.attention_fn is not None
                 else causal_attention
             )
+            # GQA: attention_fns keep their (B, S, H, D) contract — K/V
+            # repeat up to the query head count here (the cache, when
+            # prefilling, stores the UN-repeated kv heads)
+            k_attn = _expand_kv(k, h)
+            v_attn = _expand_kv(v, h)
             div = getattr(attn, "requires_seq_divisible", 0)
             if prefill and div and x.shape[1] % div:
                 # sequence-parallel schedules (ring/Ulysses) require the
@@ -266,7 +322,7 @@ class Attention(nn.Module):
                 # schedule and its memory bound; other custom fns (e.g.
                 # the Pallas flash kernel) handle any length. (ADVICE r3)
                 attn = causal_attention
-            out = attn(q, k, v)
+            out = attn(q, k_attn, v_attn)
         return out_proj(out)
 
 

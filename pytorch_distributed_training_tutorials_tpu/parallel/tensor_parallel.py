@@ -59,12 +59,26 @@ def spec_for_path(
     rules: Sequence[tuple[str, PartitionSpec]],
     default: PartitionSpec = PartitionSpec(),
     mesh: Mesh | None = None,
+    shape: tuple[int, ...] | None = None,
 ) -> PartitionSpec:
     """First matching rule wins; unmatched params use ``default``
-    (replicated). With ``mesh``, axis names the mesh lacks are dropped."""
+    (replicated). With ``mesh``, axis names the mesh lacks are dropped;
+    with ``shape`` too, axes that do not divide their dim are dropped
+    (replicated) — e.g. GQA's 1-head k_proj under the Megatron head split
+    (a size-1 dim cannot shard over a 2-wide model axis; replicating it
+    is the correct degenerate layout, not an error)."""
     for pattern, spec in rules:
         if re.search(pattern, path):
-            return _filter_spec(_pad_spec(spec, ndim), mesh)
+            out = _filter_spec(_pad_spec(spec, ndim), mesh)
+            if shape is not None and mesh is not None:
+                out = PartitionSpec(*(
+                    ax
+                    if ax is None
+                    or shape[i] % mesh.shape.get(ax, 1) == 0
+                    else None
+                    for i, ax in enumerate(out)
+                ))
+            return out
     return default
 
 
@@ -116,6 +130,7 @@ class TensorParallel:
                 spec_for_path(
                     _path_str(kp), getattr(leaf, "ndim", 0), self.rules,
                     mesh=self.mesh,
+                    shape=tuple(getattr(leaf, "shape", ()) or ()) or None,
                 ),
             ),
             abstract_variables,
@@ -138,7 +153,8 @@ class TensorParallel:
         def visit(kp, leaf):
             path = _path_str(kp)
             spec = spec_for_path(
-                path, getattr(leaf, "ndim", 0), self.rules, mesh=self.mesh
+                path, getattr(leaf, "ndim", 0), self.rules, mesh=self.mesh,
+                shape=tuple(leaf.shape),
             )
             lines.append(f"{path}: {tuple(leaf.shape)} -> {tuple(spec)}")
 

@@ -210,3 +210,22 @@ def test_grouped_moe_decodes():
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
     out = generate(model, params, tokens, max_new_tokens=3)
     assert out.shape == (1, 7)
+
+
+def test_grouped_dispatch_pads_odd_lengths():
+    """Non-divisible sequence lengths pad the tail group (masked pad
+    tokens take no capacity); with headroom the output still equals the
+    ungrouped dispatch on the real rows."""
+    d, e, s = 16, 4, 60  # 60 % 16 != 0 -> pad 4
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, s, d))
+    dense = MoEFFN(num_experts=e, top_k=2, capacity_factor=float(e))
+    grouped = MoEFFN(
+        num_experts=e, top_k=2, capacity_factor=float(e), group_size=16
+    )
+    params = dense.init(jax.random.PRNGKey(1), x)
+    out_d, _ = dense.apply(params, x, mutable=["losses"])
+    out_g, _ = grouped.apply(params, x, mutable=["losses"])
+    assert out_g.shape == x.shape
+    np.testing.assert_allclose(
+        np.asarray(out_d), np.asarray(out_g), rtol=1e-5, atol=1e-5
+    )
