@@ -140,7 +140,11 @@ def write_synthetic_checkpoint(cfg, path: str, seed: int = 0) -> int:
 
 def load_streamed(cfg, path: str, mesh):
     """Stream-quantize every top-level subtree checkpoint back into the
-    int8 serving layout (placed per INT8_TP_RULES when ``mesh``)."""
+    int8 serving layout (placed per INT8_TP_RULES when ``mesh``).
+
+    ``materialize=False`` per subtree: main() materializes the final
+    assembled (and possibly stacked) tree in ONE pass instead of paying
+    a jit trace + launch per subtree here."""
     from pytorch_distributed_training_tutorials_tpu.models.transformer import (
         load_quantized_lm,
     )
@@ -150,7 +154,9 @@ def load_streamed(cfg, path: str, mesh):
         if name == "COMPLETE":
             continue
         params.update(
-            load_quantized_lm(os.path.join(path, name), mesh=mesh)
+            load_quantized_lm(
+                os.path.join(path, name), mesh=mesh, materialize=False
+            )
         )
     return params
 
@@ -168,6 +174,14 @@ def main():
         "--json", default=None, metavar="PATH",
         help="also write a machine-readable receipt (params, bytes, load "
         "time, decode tok/s) to PATH",
+    )
+    ap.add_argument(
+        "--unrolled", action="store_true",
+        help="serve with L unrolled block copies instead of the default "
+        "stacked nn.scan body (the unrolled program is O(L) larger; on "
+        "tunneled runtimes whose launch latency scales with program size "
+        "it decodes ~an order of magnitude slower — see "
+        "models.transformer.stack_quantized_lm_params)",
     )
     args = ap.parse_args()
 
@@ -239,8 +253,39 @@ def main():
         f"f32 tree would be {f32_gb:.1f} GB)"
     )
 
-    serve_cfg = dataclasses.replace(cfg, quantized=True, int8_mesh=mesh)
+    scan_layers = not args.unrolled
+    if scan_layers:
+        # one scanned block body instead of n_layers unrolled copies:
+        # O(1) program size in depth. On this tunneled runtime the
+        # unrolled 16-layer decode paid ~20-50 s PER LAUNCH (~0.14 s of
+        # device work, trace-verified) — program size is serving latency.
+        from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+            stack_quantized_lm_params,
+        )
+
+        params = stack_quantized_lm_params(params)
+        if mesh is not None:
+            from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+                place_int8_lm_params,
+            )
+
+            params = place_int8_lm_params(params, mesh)
+    # ONE device-materialize pass over the final tree: loaded (host-put)
+    # buffers re-stream through the tunnel on every consuming launch until
+    # rewritten as device-computed buffers (DECODE_r04.md: 2.7 -> 508
+    # tok/s), and doing it here — after stacking/placement — avoids
+    # re-materializing per subtree or materializing buffers stacking
+    # replaces
+    from pytorch_distributed_training_tutorials_tpu.utils.tree import (
+        device_materialize,
+    )
+
+    params = device_materialize(params)
+    serve_cfg = dataclasses.replace(
+        cfg, quantized=True, int8_mesh=mesh, scan_layers=scan_layers
+    )
     lm = TransformerLM(serve_cfg)
+    receipt["scan_layers"] = scan_layers
     rng = np.random.Generator(np.random.PCG64(7))
     prompt = jnp.asarray(
         rng.integers(0, cfg.vocab_size, (args.batch, args.prompt_len)),
@@ -254,18 +299,27 @@ def main():
     out = generate(lm, params, prompt, args.new_tokens)
     int(out[0, -1])  # close the region with a real fetch
     compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = generate(lm, params, prompt, args.new_tokens)
-    # close the timed region with a one-element D2H — block_until_ready
-    # alone under-reports on the tunneled runtime (CLAUDE.md)
-    int(out[0, -1])
-    gen_s = time.perf_counter() - t0
+    # min-of-2: individual launches on the tunneled runtime suffer rare
+    # multi-tens-of-seconds stalls (CLAUDE.md; observed here: the same
+    # compiled generate measured 47 s in one run and 14.5 s in the next —
+    # a 3.3x swing that is tunnel weather, not the kernel). Both samples
+    # are reported so the receipt shows its own spread.
+    gen_samples = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = generate(lm, params, prompt, args.new_tokens)
+        # close the timed region with a one-element D2H —
+        # block_until_ready alone under-reports on the tunneled runtime
+        int(out[0, -1])
+        gen_samples.append(time.perf_counter() - t0)
+    gen_s = min(gen_samples)
     toks = args.batch * args.new_tokens
     receipt.update(
         batch=args.batch,
         prompt_len=args.prompt_len,
         new_tokens=args.new_tokens,
         decode_tok_per_s=round(toks / gen_s, 1),
+        decode_s_samples=[round(s, 2) for s in gen_samples],
         first_call_incl_compile_s=round(compile_s, 1),
         backend=jax.default_backend(),
     )

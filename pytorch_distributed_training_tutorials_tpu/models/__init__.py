@@ -43,4 +43,5 @@ from pytorch_distributed_training_tutorials_tpu.models.generate import (  # noqa
 from pytorch_distributed_training_tutorials_tpu.models.transformer import (  # noqa: F401
     load_quantized_lm,
     quantize_lm_params,
+    stack_quantized_lm_params,
 )

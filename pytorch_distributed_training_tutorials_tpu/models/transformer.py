@@ -395,10 +395,9 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, decode: bool = False, prefill: bool = False):
         cfg = self.cfg
-        if cfg.quantized and (cfg.scan_layers or cfg.moe_experts):
+        if cfg.quantized and cfg.moe_experts:
             raise ValueError(
-                "quantized serving supports unrolled dense blocks only "
-                "(no scan_layers, no MoE)"
+                "quantized serving supports dense blocks only (no MoE)"
             )
         if tokens.shape[1] > cfg.max_seq_len:
             raise ValueError(
@@ -548,15 +547,18 @@ def quantize_lm_params(params):
     The ``from_pretrained(load_in_8bit=True)`` conversion step, done
     explicitly: pairs with :func:`..parallel.auto.load_quantized` (which
     streams + quantizes a checkpoint leaf-by-leaf) when the checkpoint is
-    on disk, or runs directly on in-memory params. Unrolled layers only
-    (``scan_layers=False`` — a leading layer axis would need per-layer
-    scales).
+    on disk, or runs directly on in-memory params. Handles both layer
+    layouts: unrolled (``block_i/...``) and ``scan_layers=True``
+    (``layers/block/...`` — kernels carry a leading layer axis and are
+    quantized per layer, so every layer gets its own scales;
+    ``quantize(stack(f32)) == stack(quantize(f32))`` exactly, pinned by
+    ``tests/test_int8_serving.py``).
     """
     from pytorch_distributed_training_tutorials_tpu.ops.quant import quantize_int8
 
     from collections.abc import Mapping
 
-    def walk(tree):
+    def walk(tree, stacked=False):
         out = {}
         for name, sub in tree.items():
             if (
@@ -565,11 +567,16 @@ def quantize_lm_params(params):
                 and "kernel" in sub
             ):
                 out[name] = {
-                    **_quantize_kernel(name, sub["kernel"], quantize_int8),
+                    **_quantize_kernel(
+                        name, sub["kernel"], quantize_int8, stacked=stacked
+                    ),
                     **{k: v for k, v in sub.items() if k != "kernel"},
                 }
             elif isinstance(sub, Mapping):
-                out[name] = walk(sub)
+                # under the nn.scan stack ("layers"), kernels carry a
+                # leading (n_layers,) axis that must not be mistaken for
+                # the contraction dim
+                out[name] = walk(sub, stacked=stacked or name == "layers")
             else:
                 out[name] = sub
         return out
@@ -577,7 +584,52 @@ def quantize_lm_params(params):
     return walk(dict(params))
 
 
-def _quantize_kernel(name: str, kernel, quantize_int8) -> dict:
+def stack_quantized_lm_params(params):
+    """Convert an unrolled quantized serving tree (``block_0`` ..
+    ``block_{L-1}``) into the ``scan_layers=True`` layout
+    (``layers/block/...`` with a leading layer axis on every leaf).
+
+    Why: the unrolled serving graph contains L copies of the block body;
+    the scanned graph contains one. That makes compile time and executable
+    size O(1) in depth — and on tunneled runtimes whose per-launch latency
+    scales with program size (measured round 4: the 16-layer 1.2B unrolled
+    decode paid ~20-50 s per launch against ~0.14 s of device work), it is
+    the difference between unusable and interactive serving. Parity with
+    the reference's ``device_map="auto"`` serving path (SURVEY C13) is
+    unchanged — same weights, same math, one program shape.
+
+    Float leaves (norms) stack the same way; per-layer int8 scales are
+    exactly the per-layer quantization (``quantize(stack) ==
+    stack(quantize)``). Serve with ``dataclasses.replace(cfg,
+    quantized=True, scan_layers=True)``. For tensor-parallel serving,
+    re-place the stacked tree (:func:`place_int8_lm_params`) — the
+    INT8_TP_RULES specs left-pad ``None`` over the new leading axis.
+    """
+    blocks = {}
+    rest = {}
+    for name, sub in dict(params).items():
+        if name.startswith("block_"):
+            blocks[int(name[len("block_"):])] = sub
+        else:
+            rest[name] = sub
+    if not blocks:
+        raise ValueError(
+            "no block_<i> subtrees found — already stacked, or not a "
+            "TransformerLM serving tree"
+        )
+    n = len(blocks)
+    if sorted(blocks) != list(range(n)):
+        raise ValueError(f"non-contiguous block indices: {sorted(blocks)}")
+    ordered = [blocks[i] for i in range(n)]
+    rest["layers"] = {
+        "block": jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *ordered
+        )
+    }
+    return rest
+
+
+def _quantize_kernel(name: str, kernel, quantize_int8, stacked=False) -> dict:
     """One matmul kernel -> {'q', 'scale'} in the serving layout (2-D
     flattened the way Int8DenseGeneral stores it).
 
@@ -587,8 +639,21 @@ def _quantize_kernel(name: str, kernel, quantize_int8) -> dict:
     Adding a new name to ``_QUANTIZED_KERNELS`` requires deciding its split
     here — an unknown name is NOT quantized (it passes through as float),
     so a mistake fails loud (missing 'q' param), never silently wrong.
+
+    ``stacked``: the kernel carries a leading ``(n_layers,)`` scan axis;
+    each layer is quantized independently (per-layer scales), matching
+    what ``nn.scan`` slices per iteration.
     """
     kern = jnp.asarray(kernel)
+    if stacked:
+        if kern.ndim < 3:
+            raise ValueError(f"{name}: stacked kernel rank {kern.ndim} < 3")
+        qs, scales = [], []
+        for l in range(kern.shape[0]):
+            part = _quantize_kernel(name, kern[l], quantize_int8)
+            qs.append(part["q"])
+            scales.append(part["scale"])
+        return {"q": jnp.stack(qs), "scale": jnp.stack(scales)}
     if kern.ndim < 2:
         raise ValueError(f"{name}: kernel rank {kern.ndim} < 2")
     if name == "o_proj":
@@ -599,9 +664,20 @@ def _quantize_kernel(name: str, kernel, quantize_int8) -> dict:
     return {"q": qp.q, "scale": qp.scale.reshape(1, -1)}
 
 
-def load_quantized_lm(path, mesh=None):
+def load_quantized_lm(path, mesh=None, *, materialize=True):
     """Stream a trained f32 :class:`TransformerLM` checkpoint straight into
     the ``quantized=True`` serving layout, one leaf at a time.
+
+    Handles both layer layouts: unrolled (``block_i/...``) and
+    ``scan_layers=True`` checkpoints (kernels under ``layers/`` carry a
+    leading layer axis and are quantized per layer).
+
+    ``materialize=False`` skips the terminal
+    :func:`..utils.tree.device_materialize` pass — for callers that
+    assemble or transform several loaded subtrees and materialize the
+    final tree once (``examples/serve_llm_int8.py``); anything consumed
+    directly should keep the default (host-put buffers re-stream per
+    launch on tunneled runtimes — DECODE_r04.md).
 
     The full ``from_pretrained(..., load_in_8bit=True)`` loop (reference
     ``03.model_parallel.ipynb`` cell 2, SURVEY C13) on the flagship model:
@@ -652,7 +728,13 @@ def load_quantized_lm(path, mesh=None):
                 and keys[-1] == "kernel"
                 and keys[-2] in _QUANTIZED_KERNELS
             ):
-                qs = _quantize_kernel(keys[-2], leaf, quantize_int8)
+                qs = _quantize_kernel(
+                    keys[-2], leaf, quantize_int8,
+                    # scan_layers checkpoints stack kernels under
+                    # "layers/" with a leading layer axis — quantize per
+                    # layer, never across the layer dim
+                    stacked="layers" in keys[:-1],
+                )
                 del leaf  # free the f32 kernel before the next read
                 node.update(
                     {
@@ -662,4 +744,14 @@ def load_quantized_lm(path, mesh=None):
                 )
             else:
                 node[keys[-1]] = place(keys, leaf)
-    return out
+    if not materialize:
+        return out
+    # host-put buffers can stay host-backed on tunneled runtimes and
+    # re-stream on EVERY consuming launch (measured: ~16 s per 1.2B
+    # generate() call); one on-device identity pass makes them
+    # device-resident for good. See utils.tree.device_materialize.
+    from pytorch_distributed_training_tutorials_tpu.utils.tree import (
+        device_materialize,
+    )
+
+    return device_materialize(out)

@@ -58,6 +58,13 @@ def load_sharded(
     memory-fit pass, the caller states where every weight lives (replicated,
     batch-axis sharded, stage-placed, ...) and orbax restores each shard
     directly into that placement — no full-model host materialization.
+
+    The restored tree is passed through
+    :func:`..utils.tree.device_materialize` (a jitted exact identity):
+    on tunneled runtimes host-put buffers can stay host-backed and
+    re-stream on every consuming launch (measured round 4: ~16 s/launch on
+    a 1.2B serving tree, 0.13 s after); a training step's donated update
+    would fix params after one step, but eval/serving never rewrites them.
     """
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
@@ -70,7 +77,11 @@ def load_sharded(
             ),
             meta.item_metadata if hasattr(meta, "item_metadata") else meta,
         )
-        return ckptr.restore(path, abstract)
+        restored = ckptr.restore(path, abstract)
+
+    from pytorch_distributed_training_tutorials_tpu.utils.tree import device_materialize
+
+    return device_materialize(restored)
 
 
 def checkpoint_leaf_metadata(path: str | os.PathLike):

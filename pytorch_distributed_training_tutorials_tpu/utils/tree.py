@@ -13,3 +13,39 @@ def keystr(key_path) -> str:
     return "/".join(
         str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
     )
+
+
+def device_materialize(tree):
+    """Rewrite every array leaf as the OUTPUT of an on-device computation
+    (a jitted exact identity: ``leaf + zeros((), dtype)``).
+
+    Why this exists (measured, round 4, tunneled TPU runtime): buffers that
+    enter the device via host ``device_put`` — every orbax-restored
+    checkpoint leaf — can stay host-backed, and EVERY launch that consumes
+    them re-streams their bytes through the tunnel. The 1.2B int8 serving
+    tree paid ~16 s per generate() launch for ~0.14 s of device work;
+    after this one-time pass (one launch, device-side copy) the same
+    launch took 0.13 s, values bit-identical. XLA-computed buffers are
+    device-resident; this converts loaded buffers into exactly those.
+
+    Safe anywhere: a single fused launch for the whole tree, exact for
+    every dtype (+0 in the leaf's own dtype), and jit's default sharding
+    propagation preserves each leaf's placement (replicated or
+    NamedSharding'd trees come back placed the same way). On non-tunneled
+    runtimes it costs one pass of device memory bandwidth and changes
+    nothing else. Non-array leaves pass through untouched.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    is_arr = [hasattr(l, "dtype") and hasattr(l, "ndim") for l in leaves]
+    arrays = [l for l, a in zip(leaves, is_arr) if a]
+    if arrays:
+        arrays = jax.jit(
+            lambda ls: [l + jnp.zeros((), l.dtype) for l in ls]
+        )(arrays)
+        arrays = jax.block_until_ready(arrays)
+    it = iter(arrays)
+    out = [next(it) if a else l for l, a in zip(leaves, is_arr)]
+    return jax.tree_util.tree_unflatten(treedef, out)

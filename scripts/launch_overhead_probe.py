@@ -1,0 +1,105 @@
+"""Separate the tunnel's fixed per-launch cost from true per-op device time.
+
+Motivation (round 4): short-chain timings had been read as a "~2-3 ms
+per-matmul floor at decode shapes (M=4), regardless of path" — Pallas int8,
+XLA dequant, and plain bf16 dots all measured ~2.5-3.5 ms/matmul in a
+32-long ``lax.scan`` chain. This probe shows that number is an ARTIFACT:
+wall(chain) fits ``fixed + per_op * len``, and varying the chain length
+separates the terms. Measured on the tunneled v5e (2026-07-31):
+
+- fixed per-launch (launch + one-element fetch roundtrip): ~75-130 ms,
+  drifting; identical for 2 vs 256 argument buffers (no per-arg cost) and
+  for 1 GB vs 1 KB of resident argument bytes;
+- per-op device time at (4, 2048) x (2048, 8192): bf16 dot ~85 us,
+  Pallas int8 kernel ~57 us (it reads half the bytes) — both at the HBM
+  roofline, NO per-op floor, and no Pallas-in-loop penalty;
+- rare multi-second stalls poison individual launches (min-of-N or the
+  fit below are mandatory).
+
+Consequence: serving-decode latency on this runtime is launch/stall-bound,
+not kernel-bound, and *bigger timed regions* (longer chains, fused decode
+loops) are the honest way to measure it. ``min_over`` runs below reject
+stalls; the linear fit reports both terms.
+
+Usage: python scripts/launch_overhead_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _min_over(f, n: int = 4) -> float:
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tutorials_tpu.ops.quant import (
+        int8_matmul,
+        quantize_int8,
+    )
+
+    key = jax.random.PRNGKey(0)
+    m, k, n = 4, 2048, 8192
+    kx, kw = jax.random.split(key)
+    x = jax.device_put(jax.random.normal(kx, (m, k), jnp.float32))
+    wb = jax.device_put(jax.random.normal(kw, (k, n), jnp.bfloat16))
+    wq = jax.device_put(quantize_int8(jax.random.normal(kw, (k, n), jnp.float32)))
+
+    def chain(body, length):
+        @jax.jit
+        def run(x0):
+            return jax.lax.scan(body, x0, None, length=length)
+
+        _, ys = run(x)
+        float(ys[-1])  # compile + prime the first fetch
+
+        def timed():
+            _, ys = run(x)
+            float(ys[-1])
+
+        return _min_over(timed)
+
+    def bf16_body(c, _):
+        y = jnp.dot(c.astype(jnp.bfloat16), wb).astype(jnp.float32)
+        return c + y[:, :1] * 1e-9, y[0, 0]
+
+    def int8_body(c, _):
+        y = int8_matmul(c, wq)
+        return c + y[:, :1] * 1e-9, y[0, 0]
+
+    lens = (64, 1024)
+    for name, body in [("bf16_dot", bf16_body), ("pallas_int8", int8_body)]:
+        t_short = chain(body, lens[0])
+        t_long = chain(body, lens[1])
+        per_op_us = (t_long - t_short) / (lens[1] - lens[0]) * 1e6
+        fixed_ms = (t_short - per_op_us * 1e-6 * lens[0]) * 1e3
+        print(json.dumps({
+            "body": name,
+            "shape": [m, k, n],
+            "wall_ms": {str(lens[0]): round(t_short * 1e3, 1),
+                        str(lens[1]): round(t_long * 1e3, 1)},
+            "per_op_us": round(per_op_us, 1),
+            "fixed_launch_ms": round(fixed_ms, 1),
+            "naive_32chain_would_report_ms_per_op": round(
+                (fixed_ms / 32) + per_op_us / 1e3, 2
+            ),
+        }))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

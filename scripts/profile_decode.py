@@ -1,0 +1,114 @@
+"""Device-trace the 1.2B int8 serving decode and print the per-op table.
+
+SERVING_r04.json's decode rate (2.7 tok/s) sits ~6x below even this
+tunnel's measured elementwise HBM rate; scripts/int8_decode_sweep.py
+measured a ~2.5-3 ms device-time floor per int8 matmul at decode shapes
+regardless of weight bytes (1.5 vs 6.8 GB/s effective at 4 vs 17 MB).
+This script answers "where does the decode step actually spend device
+time" the same way PROFILE_r04.md did for the train step: capture a
+jax.profiler trace of one compiled generate() call and aggregate
+on-device op durations.
+
+Requires the cached 1b checkpoint (run examples/serve_llm_int8.py
+--preset 1b once). Usage:
+
+    python scripts/profile_decode.py [new_tokens=8]
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tutorials_tpu.models import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from pytorch_distributed_training_tutorials_tpu.models.generate import generate
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        load_quantized_lm,
+    )
+    from pytorch_distributed_training_tutorials_tpu.utils import profiling
+
+    new_tokens = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    cfg = TransformerConfig(
+        vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+        d_ff=8192, max_seq_len=512,
+    )
+    ckpt = os.path.join(os.environ.get("TMPDIR", "/tmp"), "llm_int8_1b")
+    if not os.path.isfile(os.path.join(ckpt, "COMPLETE")):
+        sys.exit(f"no cached checkpoint at {ckpt}; run the serve example first")
+
+    print("loading...", file=sys.stderr)
+    # the checkpoint is one orbax dir per top-level subtree
+    # (examples/serve_llm_int8.py write_synthetic_checkpoint layout)
+    params = {}
+    for name in sorted(os.listdir(ckpt)):
+        if name != "COMPLETE":
+            params.update(load_quantized_lm(os.path.join(ckpt, name)))
+    lm = TransformerLM(dataclasses.replace(cfg, quantized=True))
+    rng = np.random.Generator(np.random.PCG64(7))
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
+
+    int(jnp.zeros((), jnp.int32) + 1)  # prime first fetch
+    print("compiling...", file=sys.stderr)
+    out = generate(lm, params, prompt, new_tokens)
+    int(out[0, -1])
+
+    logdir = "/tmp/decode-trace"
+    with profiling.trace(logdir):
+        out = generate(lm, params, prompt, new_tokens)
+        int(out[0, -1])
+
+    durs = profiling.device_op_durations(logdir)
+    total_ms = sum(durs.values()) / 1e3
+    # drop the jit_run wrapper (it double-counts its children)
+    inner = {k: v for k, v in durs.items() if not k.startswith("jit_")}
+    inner_ms = sum(inner.values()) / 1e3
+
+    def classify(name: str) -> str:
+        n = name.lower()
+        if "int8" in n or "pallas" in n or "matmul_kernel" in n:
+            return "int8 matmul kernel"
+        if "dot" in n or "conv" in n:
+            return "other matmul/dot"
+        if "dynamic-update" in n or "dynamic_update" in n:
+            return "cache update"
+        if "copy" in n or "bitcast" in n or "transpose" in n:
+            return "copy/layout"
+        if "fusion" in n:
+            return "fusion (elementwise/other)"
+        if "reduce" in n:
+            return "reduce"
+        return "other"
+
+    by_class: dict[str, float] = collections.defaultdict(float)
+    for k, v in inner.items():
+        by_class[classify(k)] += v / 1e3
+    steps = max(new_tokens - 1, 1)
+    print(json.dumps({
+        "new_tokens": new_tokens,
+        "device_ms_total_incl_wrappers": round(total_ms, 1),
+        "device_ms_ops": round(inner_ms, 1),
+        "by_class_ms": {k: round(v, 1) for k, v in sorted(
+            by_class.items(), key=lambda kv: -kv[1])},
+        "per_decode_step_ms_ops": round(inner_ms / steps, 1),
+    }))
+    print("\ntop 40 ops (ms):")
+    for k, v in list(inner.items())[:40]:
+        print(f"  {v/1e3:10.2f}  {k[:110]}")
+
+
+if __name__ == "__main__":
+    main()

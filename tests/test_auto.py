@@ -225,3 +225,47 @@ def test_load_quantized_sharded_onto_mesh(tmp_path):
     )
     lines = audit_placement(loaded)
     assert any("int8" in ln for ln in lines)
+
+
+def test_device_materialize_identity_and_sharding():
+    """device_materialize must be an exact identity that preserves tree
+    structure, dtypes, non-array leaves, and mesh placement — its only job
+    is to turn host-put buffers into XLA-computed (device-resident) ones
+    (on the round-4 tunneled runtime: ~16 s/launch -> 0.13 s on the 1.2B
+    serving tree; on normal runtimes it is one bandwidth pass, a no-op
+    semantically)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+    from pytorch_distributed_training_tutorials_tpu.utils.tree import (
+        device_materialize,
+    )
+
+    mesh = create_mesh()
+    sharded = jax.device_put(
+        np.arange(64, dtype=np.float32).reshape(8, 8),
+        NamedSharding(mesh, PartitionSpec("data", None)),
+    )
+    tree = {
+        "a": jax.device_put(np.arange(6, dtype=np.int8)),
+        "b": {"c": sharded, "n": 7},   # non-array leaf passes through
+        "d": jnp.float32(2.5),
+    }
+    out = device_materialize(tree)
+    assert jax.tree_util.tree_structure(out) == (
+        jax.tree_util.tree_structure(tree)
+    )
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.asarray(tree["a"]))
+    assert out["a"].dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(out["b"]["c"]), np.asarray(sharded))
+    assert out["b"]["n"] == 7 and isinstance(out["b"]["n"], int)
+    assert out["d"] == jnp.float32(2.5)
+    # placement preserved through the jitted identity (spec normalizes
+    # trailing None away; compare the effective per-device shards)
+    assert out["b"]["c"].sharding.spec in (
+        PartitionSpec("data", None), PartitionSpec("data"),
+    )
+    assert (
+        out["b"]["c"].addressable_shards[0].data.shape
+        == sharded.addressable_shards[0].data.shape
+    )

@@ -106,6 +106,40 @@ def test_load_quantized_lm_streams_checkpoint(tmp_path):
     assert np.isfinite(np.asarray(logits)).all()
 
 
+def test_load_quantized_lm_scan_layers_checkpoint(tmp_path):
+    """A scan_layers=True checkpoint (kernels under layers/ with a leading
+    layer axis) must quantize per layer through the streaming load — never
+    flattening the layer axis into the contraction dim (round-4 review
+    finding: stacked kernels silently quantized to the wrong shape)."""
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        stack_quantized_lm_params,
+    )
+    from pytorch_distributed_training_tutorials_tpu.parallel.auto import save_checkpoint
+
+    cfg, model, params, tokens = _trained_pair()
+    f32_stacked = stack_quantized_lm_params(params)  # stacks any tree
+    path = os.path.join(tmp_path, "lm_scan_ckpt")
+    save_checkpoint(path, f32_stacked)
+
+    loaded = load_quantized_lm(path)
+    direct = stack_quantized_lm_params(quantize_lm_params(params))
+    assert jax.tree_util.tree_structure(loaded) == (
+        jax.tree_util.tree_structure(direct)
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        loaded,
+        direct,
+    )
+    smodel = TransformerLM(
+        dataclasses.replace(cfg, quantized=True, scan_layers=True)
+    )
+    logits = smodel.apply({"params": loaded}, tokens)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
 def test_tp_quantized_serving_matches_replicated():
     """The C13 finish line: a quantized LM sharded dp x tp over the mesh
     must generate the same greedy tokens as replicated int8 serving, with
@@ -175,15 +209,71 @@ def test_load_quantized_lm_shards_over_mesh(tmp_path):
     assert int(out.max()) < cfg.vocab_size
 
 
-def test_quantized_rejects_scan_and_moe():
+def test_quantized_rejects_moe():
     cfg = TransformerConfig(
         vocab_size=32, d_model=32, n_layers=2, n_heads=2,
-        quantized=True, scan_layers=True,
+        quantized=True, moe_experts=2,
     )
-    with pytest.raises(ValueError, match="unrolled dense blocks"):
+    with pytest.raises(ValueError, match="dense blocks only"):
         TransformerLM(cfg).init(
             jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
         )
+
+
+def test_stacked_quantized_serving_matches_unrolled():
+    """scan_layers=True int8 serving: one scanned block body instead of L
+    unrolled copies (O(1) program size in depth — round-4 finding: on the
+    tunneled runtime the unrolled 1.2B decode paid ~20-50 s per launch for
+    ~0.14 s of device work, so program size IS serving latency there).
+    The stacked tree must produce token-identical generations."""
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        stack_quantized_lm_params,
+    )
+
+    cfg, model, params, tokens = _trained_pair()
+    qparams = quantize_lm_params(params)
+    unrolled = TransformerLM(dataclasses.replace(cfg, quantized=True))
+    stacked_params = stack_quantized_lm_params(qparams)
+    stacked = TransformerLM(
+        dataclasses.replace(cfg, quantized=True, scan_layers=True)
+    )
+    # structure matches a fresh scan-layers quantized init (checkpoints of
+    # either layout interchange)
+    init_stacked = stacked.init(jax.random.PRNGKey(0), tokens)["params"]
+    assert jax.tree_util.tree_structure(stacked_params) == (
+        jax.tree_util.tree_structure(init_stacked)
+    )
+    q = stacked_params["layers"]["block"]["attn"]["q_proj"]["q"]
+    assert q.dtype == jnp.int8 and q.shape == (2, 64, 64)
+
+    prompt = tokens[:, :4]
+    out_unrolled = generate(unrolled, qparams, prompt, max_new_tokens=6)
+    out_stacked = generate(stacked, stacked_params, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(
+        np.asarray(out_unrolled), np.asarray(out_stacked)
+    )
+
+
+def test_quantize_of_scan_tree_equals_stack_of_quantized():
+    """Training with scan_layers then quantizing must equal quantizing the
+    unrolled twin and stacking: per-layer scales are exactly the per-layer
+    quantization."""
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        stack_quantized_lm_params,
+    )
+
+    cfg, model, params, tokens = _trained_pair()
+    # build the scan-layers f32 tree from the unrolled one (same weights)
+    q_unrolled_stacked = stack_quantized_lm_params(quantize_lm_params(params))
+    f32_stacked = stack_quantized_lm_params(params)
+    q_of_stacked = quantize_lm_params(f32_stacked)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        q_of_stacked,
+        q_unrolled_stacked,
+    )
 
 
 def test_quantize_accepts_frozendict():
