@@ -746,10 +746,10 @@ def load_quantized_lm(path, mesh=None, *, materialize=True):
                 node[keys[-1]] = place(keys, leaf)
     if not materialize:
         return out
-    # host-put buffers can stay host-backed on tunneled runtimes and
-    # re-stream on EVERY consuming launch (measured: ~16 s per 1.2B
-    # generate() call); one on-device identity pass makes them
-    # device-resident for good. See utils.tree.device_materialize.
+    # without a mesh, restore_leaf lands leaves as host numpy, and jit
+    # re-uploads numpy args on EVERY call (measured: ~16 s per 1.2B
+    # generate() launch over the tunnel); one on-device identity pass
+    # pins the tree on device. See utils.tree.device_materialize.
     from pytorch_distributed_training_tutorials_tpu.utils.tree import (
         device_materialize,
     )

@@ -60,10 +60,11 @@ def load_sharded(
     directly into that placement — no full-model host materialization.
 
     The restored tree is passed through
-    :func:`..utils.tree.device_materialize` (a jitted exact identity):
-    on tunneled runtimes host-put buffers can stay host-backed and
-    re-stream on every consuming launch (measured round 4: ~16 s/launch on
-    a 1.2B serving tree, 0.13 s after); a training step's donated update
+    :func:`..utils.tree.device_materialize` (a jitted exact identity) so
+    every leaf is guaranteed device-resident: trees that pick up host
+    numpy leaves anywhere get re-uploaded by jit on every consuming call
+    (measured round 4 on the tunneled TPU: ~16 s/launch on a 1.2B serving
+    tree, 0.13 s after — DECODE_r04.md); a training step's donated update
     would fix params after one step, but eval/serving never rewrites them.
     """
     path = os.path.abspath(path)

@@ -19,14 +19,14 @@ def device_materialize(tree):
     """Rewrite every array leaf as the OUTPUT of an on-device computation
     (a jitted exact identity: ``leaf + zeros((), dtype)``).
 
-    Why this exists (measured, round 4, tunneled TPU runtime): buffers that
-    enter the device via host ``device_put`` — every orbax-restored
-    checkpoint leaf — can stay host-backed, and EVERY launch that consumes
-    them re-streams their bytes through the tunnel. The 1.2B int8 serving
-    tree paid ~16 s per generate() launch for ~0.14 s of device work;
-    after this one-time pass (one launch, device-side copy) the same
-    launch took 0.13 s, values bit-identical. XLA-computed buffers are
-    device-resident; this converts loaded buffers into exactly those.
+    Why this exists (measured, round 4 — DECODE_r04.md): checkpoint
+    restores without an explicit sharding land leaves as HOST NUMPY
+    (``parallel.auto.restore_leaf`` — by design, to keep host peak
+    one-leaf-bounded), and jit re-uploads numpy arguments on EVERY call.
+    On a PCIe host that is invisible; over the tunneled TPU's ~20 MB/s it
+    made the 1.2B int8 serving tree pay ~16 s per generate() launch for
+    ~0.14 s of device work. After this one-time pass the same launch took
+    0.13 s, values bit-identical.
 
     Safe anywhere: a single fused launch for the whole tree, exact for
     every dtype (+0 in the leaf's own dtype), and jit's default sharding
