@@ -52,6 +52,13 @@ def presets():
             vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
             d_ff=8192, max_seq_len=512,
         ),
+        # the Llama-2/3 serving layout: 4 KV heads shared by 16 query
+        # heads — k/v projections and the KV cache shrink 4x (GQA;
+        # models/transformer.py n_kv_heads)
+        "1b-gqa": TransformerConfig(
+            vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+            n_kv_heads=4, d_ff=8192, max_seq_len=512,
+        ),
         "toy": TransformerConfig(
             vocab_size=128, d_model=64, n_layers=2, n_heads=4,
             max_seq_len=64,
@@ -163,7 +170,9 @@ def load_streamed(cfg, path: str, mesh):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--preset", choices=("1b", "toy"), default="toy")
+    ap.add_argument(
+        "--preset", choices=("1b", "1b-gqa", "toy"), default="toy"
+    )
     ap.add_argument("--tp", type=int, default=1,
                     help="model-axis width for sharded int8 serving")
     ap.add_argument("--ckpt_dir", default=None)

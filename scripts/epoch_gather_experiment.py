@@ -12,6 +12,11 @@ Two candidates for feeding the whole-epoch ``lax.scan``
 Usage: python scripts/epoch_gather_experiment.py [per_device_batch] [unroll]
 Prints one JSON line with img/s for both variants, min-of-3 (CLAUDE.md:
 tunnel stalls hit individual dispatches; first fetch primed by compile leg).
+
+RESULT (round 4, v5e lite, bs512): per_step_gather 45,294 img/s vs
+pregather 44,611 — the one-big-gather variant is ~1.5% SLOWER. The
+per-step gather fuses into the step's first convolution and is not a
+bottleneck; B stays the trainer's layout.
 """
 
 from __future__ import annotations

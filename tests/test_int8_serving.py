@@ -167,6 +167,38 @@ def test_tp_quantized_serving_matches_replicated():
     np.testing.assert_array_equal(np.asarray(out_tp), np.asarray(out_rep))
 
 
+def test_tp_stacked_quantized_serving_matches_replicated():
+    """The serving default (scan_layers stacked tree) composed with tensor
+    parallelism: INT8_TP_RULES specs left-pad None over the leading layer
+    axis, so the placed stacked tree must generate the same greedy tokens
+    as replicated unrolled serving."""
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        place_int8_lm_params,
+        stack_quantized_lm_params,
+    )
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+
+    cfg, model, params, tokens = _trained_pair()
+    qparams = quantize_lm_params(params)
+    mesh = create_mesh({"data": 2, "model": 4})
+    stacked = place_int8_lm_params(stack_quantized_lm_params(qparams), mesh)
+    # the leading layer axis stays unsharded; the rule axis lands on the
+    # kernel dims (column split: q sharded (L, K, N/4) per device)
+    q = stacked["layers"]["block"]["attn"]["q_proj"]["q"]
+    assert {s.data.shape for s in q.addressable_shards} == {(2, 64, 16)}
+
+    rep = TransformerLM(dataclasses.replace(cfg, quantized=True))
+    tp_stacked = TransformerLM(
+        dataclasses.replace(
+            cfg, quantized=True, scan_layers=True, int8_mesh=mesh
+        )
+    )
+    prompt = tokens[:, :4]
+    out_rep = generate(rep, qparams, prompt, max_new_tokens=5)
+    out_tp = generate(tp_stacked, stacked, prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(out_tp), np.asarray(out_rep))
+
+
 def test_load_quantized_lm_shards_over_mesh(tmp_path):
     """Streaming load with a mesh places every int8 leaf per INT8_TP_RULES:
     column layers shard q/scale on the output dim, row layers shard q on
