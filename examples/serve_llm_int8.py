@@ -185,6 +185,20 @@ def main():
         "time, decode tok/s) to PATH",
     )
     ap.add_argument(
+        "--max_seq_len", type=int, default=None,
+        help="serve with a different context window than the preset "
+        "trained at — weights are window-agnostic (RoPE is computed, the "
+        "KV cache is config-sized), so the same checkpoint serves any "
+        "window",
+    )
+    ap.add_argument(
+        "--flash", action="store_true",
+        help="prefill through the Pallas flash-attention kernel "
+        "(ops.flash_attention) instead of dense causal attention — "
+        "sub-quadratic attention temp memory; the long-prompt path "
+        "(FLASH_r04.md). Decode always uses the cached dense path.",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -208,6 +222,15 @@ def main():
     from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
 
     cfg = presets()[args.preset]
+    if args.max_seq_len is not None:
+        # params are window-agnostic: only the cache shapes and the RoPE
+        # offsets derive from max_seq_len, so the same checkpoint serves
+        # any window (the generate() window trim still applies per request)
+        cfg = dataclasses.replace(cfg, max_seq_len=args.max_seq_len)
+    if args.flash:
+        from pytorch_distributed_training_tutorials_tpu.ops import flash_attention
+
+        cfg = dataclasses.replace(cfg, attention_fn=flash_attention)
     ckpt = args.ckpt_dir or os.path.join(
         os.environ.get("TMPDIR", "/tmp"), f"llm_int8_{args.preset}"
     )
@@ -327,6 +350,8 @@ def main():
         batch=args.batch,
         prompt_len=args.prompt_len,
         new_tokens=args.new_tokens,
+        max_seq_len=cfg.max_seq_len,
+        flash_prefill=bool(args.flash),
         decode_tok_per_s=round(toks / gen_s, 1),
         decode_s_samples=[round(s, 2) for s in gen_samples],
         first_call_incl_compile_s=round(compile_s, 1),

@@ -47,3 +47,26 @@ def test_int8_serving_example_runs(tmp_path):
     ])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "serve:" in out.stdout and "load:" in out.stdout
+
+
+@pytest.mark.slow
+def test_int8_serving_long_context_flash(tmp_path):
+    """The long-context serving composition (SERVING_r04_long.json): the
+    same checkpoint served at a different window (--max_seq_len) with
+    flash prefill (--flash) and the unrolled fallback (--unrolled) all
+    drive to completion."""
+    ck = str(tmp_path / "ck")
+    out = _run([
+        "examples/serve_llm_int8.py", "--preset", "toy",
+        "--max_seq_len", "128", "--prompt_len", "48", "--new_tokens", "4",
+        "--batch", "2", "--flash", "--ckpt_dir", ck,
+    ])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "serve:" in out.stdout
+    out2 = _run([
+        "examples/serve_llm_int8.py", "--preset", "toy", "--unrolled",
+        "--prompt_len", "8", "--new_tokens", "4", "--batch", "2",
+        "--ckpt_dir", ck,  # reuses the checkpoint written above
+    ])
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    assert "serve:" in out2.stdout
