@@ -1,12 +1,17 @@
 """Sweep int8_matmul block sizes at serving-decode shapes (real chip).
 
-Decode matmuls are (M=batch, K) @ (K, N) with M tiny (4-8). The kernel's
-default blocking (256, 256, 512) was tuned for prefill/training shapes; at
-M=4 it degenerates to a long chain of small grid steps whose fixed per-step
-cost dominates on this tunneled runtime (CLAUDE.md: ~2 ms/call floor at toy
-decode shapes; SERVING_r04.json's 1.2B decode runs ~6x below even the
-tunnel's measured elementwise HBM rate). This sweep asks: at the 1b preset's
-three decode matmul shapes, which (block_n, block_k) minimizes time?
+HISTORICAL NOTE (round 4): this sweep's original premise — a per-matmul
+"floor" at decode shapes — was wrong. Its 32-long chains divided a fixed
+~75-130 ms per-LAUNCH roundtrip, which is why every size/path/blocking
+"measured" ~2.5-3.5 ms: see scripts/launch_overhead_probe.py for the
+corrected methodology (fit wall = fixed + per_op * len over two chain
+lengths) and DECODE_r04.md for the full story. Kept because the RELATIVE
+blocking comparison at fixed chain length is still valid (the fixed term
+cancels), just not the absolute per-op numbers.
+
+Decode matmuls are (M=batch, K) @ (K, N) with M tiny (4-8). This sweep
+asks: at the 1b preset's decode matmul shapes, which (block_n, block_k)
+minimizes time?
 
 Timing: each config runs a jitted ``lax.scan`` chain of 32 applications
 (one launch + one terminal fetch), min-of-3. Prints one JSON line per

@@ -1,13 +1,13 @@
 """Device-trace the 1.2B int8 serving decode and print the per-op table.
 
-SERVING_r04.json's decode rate (2.7 tok/s) sits ~6x below even this
-tunnel's measured elementwise HBM rate; scripts/int8_decode_sweep.py
-measured a ~2.5-3 ms device-time floor per int8 matmul at decode shapes
-regardless of weight bytes (1.5 vs 6.8 GB/s effective at 4 vs 17 MB).
 This script answers "where does the decode step actually spend device
 time" the same way PROFILE_r04.md did for the train step: capture a
 jax.profiler trace of one compiled generate() call and aggregate
-on-device op durations.
+on-device op durations. Round-4 finding (DECODE_r04.md): the 1.2B decode
+executes ~3.6 ms/step on device; the original 2.7 tok/s receipt was
+numpy-leaf re-upload (fixed by utils.tree.device_materialize), not
+device time — this trace was the evidence (device busy 0.08 s inside a
+16 s wall, one 16.18 s idle gap before the main program's first op).
 
 Requires the cached 1b checkpoint (run examples/serve_llm_int8.py
 --preset 1b once). Usage:
