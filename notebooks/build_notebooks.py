@@ -330,6 +330,43 @@ for kp, leaf in flat[:6]:
         print(f"{name}: {leaf.dtype} {leaf.shape}")
 """),
     ("md", """
+### Lesson 1b — serve it (the step the reference stops short of)
+The reference loads Llama-7B 8-bit but never generates (`GenerationConfig`
+imported, no `generate` call anywhere). Serving the quantized model exposed
+two TPU lessons, both measured at 1.2B scale on a real chip (DECODE_r04.md,
+2.7 -> 508 tok/s):
+
+1. **One scanned block body, not L unrolled copies** — serve with
+   `scan_layers=True` and `stack_quantized_lm_params` (per-layer int8
+   scales are exactly per-layer quantization; generations are
+   token-identical). Compile time and program size become O(1) in depth.
+2. **Pin loaded checkpoints on device** — leaf-streamed restores land as
+   host numpy, and jit re-uploads numpy arguments on *every* call
+   (invisible over PCIe, ~16 s/launch over a thin tunnel).
+   `utils.tree.device_materialize` is one exact-identity launch that
+   fixes it; `load_quantized_lm` applies it automatically.
+"""),
+    ("code", """
+import dataclasses
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    quantize_lm_params, stack_quantized_lm_params,
+)
+from pytorch_distributed_training_tutorials_tpu.models.generate import generate
+from pytorch_distributed_training_tutorials_tpu.utils.tree import device_materialize
+
+qparams = quantize_lm_params(dict(variables["params"]))
+stacked = device_materialize(stack_quantized_lm_params(qparams))
+serve_lm = TransformerLM(
+    dataclasses.replace(cfg, quantized=True, scan_layers=True)
+)
+prompt = (jnp.arange(8, dtype=jnp.int32)[None].repeat(2, 0)) % cfg.vocab_size
+out = generate(serve_lm, stacked, prompt, max_new_tokens=8)
+print("generated:", np.asarray(out[:, 8:]))
+# one (L, ...) leaf per weight instead of L separate copies:
+print("stacked q_proj q:",
+      stacked["layers"]["block"]["attn"]["q_proj"]["q"].shape, "int8")
+"""),
+    ("md", """
 ## Lesson 2 — the toy 2-device split
 The reference pins `net1` to `cuda:0`, `net2` to `cuda:1`, and calls
 `x.to("cuda:1")` mid-forward (cells 7/12). The twin: each stage is its own
