@@ -192,6 +192,13 @@ def main():
         "window",
     )
     ap.add_argument(
+        "--kv_cache_dtype", choices=("f32", "bf16"), default="f32",
+        help="KV-cache storage dtype: bf16 halves per-step cache traffic "
+        "(decode at long windows is cache-bound, DECODE_r04.md) at the "
+        "cost of rounding stored K/V — greedy tokens can diverge at "
+        "near-ties",
+    )
+    ap.add_argument(
         "--flash", action="store_true",
         help="prefill through the Pallas flash-attention kernel "
         "(ops.flash_attention) instead of dense causal attention — "
@@ -231,6 +238,10 @@ def main():
         from pytorch_distributed_training_tutorials_tpu.ops import flash_attention
 
         cfg = dataclasses.replace(cfg, attention_fn=flash_attention)
+    if args.kv_cache_dtype == "bf16":
+        import jax.numpy as _jnp
+
+        cfg = dataclasses.replace(cfg, kv_cache_dtype=_jnp.bfloat16)
     ckpt = args.ckpt_dir or os.path.join(
         os.environ.get("TMPDIR", "/tmp"), f"llm_int8_{args.preset}"
     )
@@ -352,6 +363,7 @@ def main():
         new_tokens=args.new_tokens,
         max_seq_len=cfg.max_seq_len,
         flash_prefill=bool(args.flash),
+        kv_cache_dtype=args.kv_cache_dtype,
         decode_tok_per_s=round(toks / gen_s, 1),
         decode_s_samples=[round(s, 2) for s in gen_samples],
         first_call_incl_compile_s=round(compile_s, 1),

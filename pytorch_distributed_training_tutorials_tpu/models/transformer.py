@@ -70,6 +70,14 @@ class TransformerConfig:
     # from quantize_lm_params(f32_params) or load_quantized_lm(path);
     # training is not supported.
     quantized: bool = False
+    # KV-cache storage dtype (None = follow the K/V compute dtype, exact).
+    # At long windows decode is CACHE-bound, not weight-bound (the 1b
+    # preset at a 2080-token window reads ~2.2 GB f32 of cache vs ~1.2 GB
+    # int8 of weights per step — DECODE_r04.md); jnp.bfloat16 halves that
+    # traffic. Opt-in because it rounds stored K/V: greedy tokens can
+    # diverge from the f32-cache reference at near-ties (scores still
+    # accumulate f32 — masked_attention's preferred_element_type).
+    kv_cache_dtype: "jnp.dtype | None" = None
     # Tensor-parallel int8 serving: a mesh with a 'model' axis routes every
     # quantized matmul through the shard_map-wrapped kernel
     # (ops.quant.int8_matmul_tp) in the Megatron column/row layout; q/scale
@@ -195,9 +203,13 @@ class Attention(nn.Module):
     def _cache_vars(self, b: int, k_dtype, v_dtype):
         """The one copy of the KV-cache schema shared by the decode and
         prefill branches (shapes/dtypes must agree or decode misreads what
-        prefill wrote). Only ``kv_heads`` heads are cached (GQA)."""
+        prefill wrote). Only ``kv_heads`` heads are cached (GQA);
+        ``cfg.kv_cache_dtype`` overrides the storage dtype (long-window
+        decode is cache-traffic-bound — see the config field)."""
         cfg = self.cfg
         h, d = cfg.kv_heads, cfg.head_dim
+        if cfg.kv_cache_dtype is not None:
+            k_dtype = v_dtype = cfg.kv_cache_dtype
         cached_k = self.variable(
             "cache", "cached_key",
             jnp.zeros, (b, cfg.max_seq_len, h, d), k_dtype,
@@ -265,10 +277,10 @@ class Attention(nn.Module):
             q = apply_rope(q_raw, cfg.rope_theta, offset=pos)
             k = apply_rope(k_raw, cfg.rope_theta, offset=pos)
             cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k, (0, pos, 0, 0)
+                cached_k.value, k.astype(cached_k.value.dtype), (0, pos, 0, 0)
             )
             cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v, (0, pos, 0, 0)
+                cached_v.value, v.astype(cached_v.value.dtype), (0, pos, 0, 0)
             )
             idx.value = pos + 1
             # attend over the whole cache, masking positions beyond `pos`;
@@ -295,10 +307,12 @@ class Attention(nn.Module):
                     b, k_raw.dtype, v.dtype
                 )
                 cached_k.value = jax.lax.dynamic_update_slice(
-                    cached_k.value, k, (0, 0, 0, 0)
+                    cached_k.value, k.astype(cached_k.value.dtype),
+                    (0, 0, 0, 0)
                 )
                 cached_v.value = jax.lax.dynamic_update_slice(
-                    cached_v.value, v, (0, 0, 0, 0)
+                    cached_v.value, v.astype(cached_v.value.dtype),
+                    (0, 0, 0, 0)
                 )
                 idx.value = jnp.asarray(s, jnp.int32)
             attn = (

@@ -321,3 +321,34 @@ def test_quantize_accepts_frozendict():
         a,
         b,
     )
+
+
+def test_bf16_kv_cache_serving():
+    """kv_cache_dtype=bf16 halves cache bytes (long-window decode is
+    cache-traffic-bound — DECODE_r04.md). Opt-in because stored K/V are
+    rounded: assert the cache really is bf16, generations still come from
+    a coherent prefix (prompt preserved, tokens in-vocab), and the greedy
+    path agrees with the exact f32 cache at a high rate on a toy model."""
+    cfg, model, params, tokens = _trained_pair()
+    qparams = quantize_lm_params(params)
+    exact = TransformerLM(dataclasses.replace(cfg, quantized=True))
+    rounded = TransformerLM(
+        dataclasses.replace(
+            cfg, quantized=True, kv_cache_dtype=jnp.bfloat16
+        )
+    )
+    # the cache vars really store bf16
+    _, upd = rounded.apply(
+        {"params": qparams}, tokens, prefill=True, mutable=["cache"]
+    )
+    for leaf in jax.tree_util.tree_leaves(upd["cache"]):
+        if leaf.ndim == 4:  # cached_key / cached_value (not cache_index)
+            assert leaf.dtype == jnp.bfloat16, leaf.dtype
+
+    prompt = tokens[:, :4]
+    out_exact = np.asarray(generate(exact, qparams, prompt, max_new_tokens=8))
+    out_bf16 = np.asarray(generate(rounded, qparams, prompt, max_new_tokens=8))
+    np.testing.assert_array_equal(out_bf16[:, :4], np.asarray(prompt))
+    assert out_bf16.max() < cfg.vocab_size
+    agree = (out_exact == out_bf16).mean()
+    assert agree >= 0.75, f"greedy agreement {agree} vs f32 cache"
