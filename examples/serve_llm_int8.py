@@ -192,6 +192,15 @@ def main():
         "window",
     )
     ap.add_argument(
+        "--temperature", type=float, default=0.0,
+        help="0 = greedy (the receipt default); > 0 samples at this "
+        "temperature (optionally filtered by --top_k / --top_p)",
+    )
+    ap.add_argument("--top_k", type=int, default=0,
+                    help="keep only the k highest logits when sampling")
+    ap.add_argument("--top_p", type=float, default=1.0,
+                    help="nucleus sampling mass when sampling")
+    ap.add_argument(
         "--kv_cache_dtype", choices=("f32", "bf16"), default="f32",
         help="KV-cache storage dtype: bf16 halves per-step cache traffic "
         "(decode at long windows is cache-bound, DECODE_r04.md) at the "
@@ -335,11 +344,20 @@ def main():
         jnp.int32,
     )
 
+    sample_kw = {}
+    if args.temperature > 0:
+        import jax as _jax
+
+        sample_kw = dict(
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, rng=_jax.random.PRNGKey(7),
+        )
+
     # prime the process's first D2H fetch OUTSIDE any timed region (the
     # ~19 s tunnel stall would otherwise be charged to compile_s)
     int(jnp.zeros((), jnp.int32) + 1)
     t0 = time.perf_counter()
-    out = generate(lm, params, prompt, args.new_tokens)
+    out = generate(lm, params, prompt, args.new_tokens, **sample_kw)
     int(out[0, -1])  # close the region with a real fetch
     compile_s = time.perf_counter() - t0
     # min-of-2: individual launches on the tunneled runtime suffer rare
@@ -350,7 +368,7 @@ def main():
     gen_samples = []
     for _ in range(2):
         t0 = time.perf_counter()
-        out = generate(lm, params, prompt, args.new_tokens)
+        out = generate(lm, params, prompt, args.new_tokens, **sample_kw)
         # close the timed region with a one-element D2H —
         # block_until_ready alone under-reports on the tunneled runtime
         int(out[0, -1])

@@ -31,14 +31,41 @@ import jax
 import jax.numpy as jnp
 
 
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Standard serving logit filters, XLA-friendly (static shapes, no
+    data-dependent control flow): ``top_k`` keeps the k highest logits,
+    ``top_p`` (nucleus) keeps the smallest set of tokens whose softmax
+    mass reaches p. Disallowed tokens get -inf so ``categorical`` never
+    picks them. Both filters compose (k first, then p, the usual order)."""
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the mass BEFORE them is < p (the first token
+        # is always kept, matching the conventional implementation)
+        keep = (cum - probs) < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 @functools.lru_cache(maxsize=64)
-def _compiled_generate(model, p_len: int, total: int, temperature: float):
+def _compiled_generate(
+    model, p_len: int, total: int, temperature: float,
+    top_k: int = 0, top_p: float = 1.0,
+):
     """Jitted batched-prefill + decode scan for fixed lengths (flax modules
     hash by structure, so this caches across calls with the same config)."""
 
     def sample(logits, key):
         if temperature > 0:
             key, sub = jax.random.split(key)
+            logits = _filter_logits(logits, top_k, top_p)
             nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
@@ -90,6 +117,8 @@ def generate(
     max_new_tokens: int,
     *,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     rng: jax.Array | None = None,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
@@ -103,7 +132,10 @@ def generate(
     decode scan.
 
     Greedy when ``temperature == 0`` (the default), otherwise softmax
-    sampling at the given temperature using ``rng``.
+    sampling at the given temperature using ``rng``, optionally filtered
+    by ``top_k`` (0 = off) and/or nucleus ``top_p`` (1.0 = off) — the
+    standard serving sampling surface. ``top_k=1`` reduces to greedy;
+    filters apply only when sampling.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
@@ -126,8 +158,14 @@ def generate(
     tokens0 = jnp.concatenate(
         [prompt, jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1
     )
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     model = _window_model(model, total)
-    run = _compiled_generate(model, p_len, total, float(temperature))
+    run = _compiled_generate(
+        model, p_len, total, float(temperature), int(top_k), float(top_p)
+    )
     return run(params, tokens0, rng)
 
 

@@ -212,3 +212,65 @@ def test_request_sized_cache_window_matches_full():
     # the rebuild branch actually ran (window 16 < 256)
     from pytorch_distributed_training_tutorials_tpu.models.generate import _window_model
     assert _window_model(model, 12).cfg.max_seq_len == 16
+
+
+def test_filter_logits_top_k_and_top_p():
+    """_filter_logits: top_k keeps exactly the k highest logits; top_p
+    keeps the smallest prefix of the sorted distribution reaching mass p
+    (first token always kept); disallowed entries become -inf."""
+    from pytorch_distributed_training_tutorials_tpu.models.generate import _filter_logits
+
+    logits = jnp.asarray([[2.0, 0.0, 1.0, -1.0]])
+    k2 = np.asarray(_filter_logits(logits, top_k=2, top_p=1.0))
+    np.testing.assert_array_equal(
+        np.isfinite(k2[0]), [True, False, True, False]
+    )
+    # top_p tiny -> only the argmax survives
+    p_small = np.asarray(_filter_logits(logits, top_k=0, top_p=1e-6))
+    np.testing.assert_array_equal(
+        np.isfinite(p_small[0]), [True, False, False, False]
+    )
+    # top_p=1.0 and top_k=0 are no-ops
+    np.testing.assert_array_equal(
+        np.asarray(_filter_logits(logits, top_k=0, top_p=1.0)),
+        np.asarray(logits),
+    )
+    # per-row independence: each row filters against its own top-k
+    two = jnp.asarray([[2.0, 0.0, 1.0, -1.0], [-1.0, 5.0, 4.0, 0.0]])
+    k1 = np.asarray(_filter_logits(two, top_k=1, top_p=1.0))
+    np.testing.assert_array_equal(
+        np.isfinite(k1), [[True, False, False, False],
+                          [False, True, False, False]]
+    )
+
+
+def test_generate_sampling_filters():
+    """The serving sampling surface: top_k=1 reduces sampling to greedy;
+    top_k=0/top_p=1.0 with the same rng reproduce unfiltered sampling; a
+    tiny nucleus also reduces to greedy."""
+    model, params = _model()
+    rng_np = np.random.Generator(np.random.PCG64(5))
+    prompt = jnp.asarray(rng_np.integers(0, 32, (2, 4)), jnp.int32)
+    key = jax.random.PRNGKey(42)
+
+    greedy = generate(model, params, prompt, max_new_tokens=6)
+    k1 = generate(model, params, prompt, max_new_tokens=6,
+                  temperature=0.8, top_k=1, rng=key)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+    plain = generate(model, params, prompt, max_new_tokens=6,
+                     temperature=0.8, rng=key)
+    off = generate(model, params, prompt, max_new_tokens=6,
+                   temperature=0.8, top_k=0, top_p=1.0, rng=key)
+    np.testing.assert_array_equal(np.asarray(off), np.asarray(plain))
+
+    p_tiny = generate(model, params, prompt, max_new_tokens=6,
+                      temperature=0.8, top_p=1e-6, rng=key)
+    np.testing.assert_array_equal(np.asarray(p_tiny), np.asarray(greedy))
+
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=0.5, top_p=0.0,
+                 rng=key)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, params, prompt, 2, temperature=0.5, top_k=-1,
+                 rng=key)
