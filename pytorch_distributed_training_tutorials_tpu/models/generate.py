@@ -65,8 +65,11 @@ def _compiled_generate(
     def sample(logits, key):
         if temperature > 0:
             key, sub = jax.random.split(key)
-            logits = _filter_logits(logits, top_k, top_p)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            # temperature BEFORE the filters (the standard pipeline order
+            # — top_k is order-invariant but the nucleus is not: it must
+            # be taken over the temperature-sharpened distribution)
+            logits = _filter_logits(logits / temperature, top_k, top_p)
+            nxt = jax.random.categorical(sub, logits, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         return nxt.astype(jnp.int32), key
@@ -133,9 +136,12 @@ def generate(
 
     Greedy when ``temperature == 0`` (the default), otherwise softmax
     sampling at the given temperature using ``rng``, optionally filtered
-    by ``top_k`` (0 = off) and/or nucleus ``top_p`` (1.0 = off) — the
-    standard serving sampling surface. ``top_k=1`` reduces to greedy;
-    filters apply only when sampling.
+    by ``top_k`` (0 = off) and/or nucleus ``top_p`` (1.0 = off), applied
+    AFTER temperature scaling — the standard serving pipeline order.
+    ``top_k=1`` reduces to greedy up to exact logit ties (a tie keeps
+    both tokens and samples between them, where argmax picks the first —
+    int8 serving does produce real ties); filters apply only when
+    sampling and are ignored (including for compile caching) when greedy.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
@@ -162,6 +168,12 @@ def generate(
         raise ValueError(f"top_k must be >= 0, got {top_k}")
     if not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature == 0:
+        # greedy ignores the filters — normalize them out of the compile
+        # cache key so greedy calls with cosmetic filter args don't
+        # retrace an identical program (compile is the multi-second cost
+        # at serving scale)
+        top_k, top_p = 0, 1.0
     model = _window_model(model, total)
     run = _compiled_generate(
         model, p_len, total, float(temperature), int(top_k), float(top_p)

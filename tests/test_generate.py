@@ -274,3 +274,21 @@ def test_generate_sampling_filters():
     with pytest.raises(ValueError, match="top_k"):
         generate(model, params, prompt, 2, temperature=0.5, top_k=-1,
                  rng=key)
+
+
+def test_greedy_ignores_filter_args_in_compile_cache():
+    """Greedy calls normalize top_k/top_p out of the compile key: cosmetic
+    filter args on a temperature=0 call must not retrace (compile is the
+    multi-second cost at serving scale)."""
+    from pytorch_distributed_training_tutorials_tpu.models.generate import (
+        _compiled_generate,
+    )
+
+    model, params = _model()
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out_a = generate(model, params, prompt, max_new_tokens=4)
+    size_after_first = _compiled_generate.cache_info().currsize
+    out_b = generate(model, params, prompt, max_new_tokens=4, top_k=50,
+                     top_p=0.9)
+    assert _compiled_generate.cache_info().currsize == size_after_first
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
