@@ -1,6 +1,9 @@
-"""Utilities: rank-0 logging, metrics formatting."""
+"""Utilities: rank-0 logging, metrics formatting, pytree helpers."""
 
 from pytorch_distributed_training_tutorials_tpu.utils.logging import (  # noqa: F401
     log0,
     epoch_line,
+)
+from pytorch_distributed_training_tutorials_tpu.utils.tree import (  # noqa: F401
+    device_materialize,
 )
