@@ -382,6 +382,14 @@ def main():
         max_seq_len=cfg.max_seq_len,
         flash_prefill=bool(args.flash),
         kv_cache_dtype=args.kv_cache_dtype,
+        # a sampled run's decode_tok_per_s is not comparable to the greedy
+        # headline — make every receipt self-describing
+        temperature=args.temperature,
+        **(
+            dict(top_k=args.top_k, top_p=args.top_p)
+            if args.temperature > 0
+            else {}
+        ),
         decode_tok_per_s=round(toks / gen_s, 1),
         decode_s_samples=[round(s, 2) for s in gen_samples],
         first_call_incl_compile_s=round(compile_s, 1),

@@ -31,24 +31,51 @@ import jax
 import jax.numpy as jnp
 
 
+# Candidate budget for nucleus (top_p) filtering when top_k is off. The
+# nucleus cutoff only depends on the highest-probability tokens, so it is
+# computed from ``lax.top_k(logits, cap)`` instead of a full-vocabulary
+# descending sort — at a 32-50k vocab the O(V log V) sort inside the
+# per-token decode scan rivals the lm_head matmul itself. Exact whenever
+# the nucleus holds <= cap tokens (always, for practical p and peaked LM
+# distributions); a flatter-than-cap distribution degrades gracefully to
+# an implicit additional top-1024 cut.
+_NUCLEUS_CANDIDATES = 1024
+
+
 def _filter_logits(logits, top_k: int, top_p: float):
     """Standard serving logit filters, XLA-friendly (static shapes, no
-    data-dependent control flow): ``top_k`` keeps the k highest logits,
+    data-dependent control flow, no full-vocab sort — ``lax.top_k`` with
+    k << V is the TPU idiom): ``top_k`` keeps the k highest logits,
     ``top_p`` (nucleus) keeps the smallest set of tokens whose softmax
     mass reaches p. Disallowed tokens get -inf so ``categorical`` never
-    picks them. Both filters compose (k first, then p, the usual order)."""
-    if top_k > 0 and top_k < logits.shape[-1]:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+    picks them. Both filters compose (k first, then p, the usual order);
+    when both are active one ``lax.top_k`` call feeds both, and the
+    nucleus mass is normalized over the k-filtered support (exactly what
+    softmax-after-the-k-filter yields)."""
+    v = logits.shape[-1]
+    k_active = 0 < top_k < v
+    vals = None
+    if k_active:
+        vals = jax.lax.top_k(logits, top_k)[0]  # descending
+        kth = vals[..., -1:]
+        # strict < keeps boundary ties, same as argmax keeping the first
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        if vals is None:
+            vals = jax.lax.top_k(logits, min(v, _NUCLEUS_CANDIDATES))[0]
+        # softmax mass of each candidate under the (k-)filtered
+        # distribution; one O(V) logsumexp pass, no sort
+        z = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+        probs = jnp.exp(vals - z)
         cum = jnp.cumsum(probs, axis=-1)
         # keep tokens while the mass BEFORE them is < p (the first token
-        # is always kept, matching the conventional implementation)
+        # is always kept, matching the conventional implementation); if
+        # every candidate is kept the cutoff is the last candidate value,
+        # so tokens below the candidate set are dropped — the documented
+        # implicit top-cap degradation
         keep = (cum - probs) < top_p
         cutoff = jnp.min(
-            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+            jnp.where(keep, vals, jnp.inf), axis=-1, keepdims=True
         )
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return logits
@@ -138,6 +165,11 @@ def generate(
     sampling at the given temperature using ``rng``, optionally filtered
     by ``top_k`` (0 = off) and/or nucleus ``top_p`` (1.0 = off), applied
     AFTER temperature scaling — the standard serving pipeline order.
+    The nucleus is resolved over the top ``min(V, 1024)`` candidate
+    tokens (``lax.top_k``, not a full-vocab sort — see
+    ``_NUCLEUS_CANDIDATES``): exact whenever the nucleus holds <= 1024
+    tokens; a flatter distribution (e.g. high temperature over an
+    untrained model) degrades to an implicit additional top-1024 cut.
     ``top_k=1`` reduces to greedy up to exact logit ties (a tie keeps
     both tokens and samples between them, where argmax picks the first —
     int8 serving does produce real ties); filters apply only when

@@ -75,8 +75,10 @@ class TransformerConfig:
     # preset at a 2080-token window reads ~2.2 GB f32 of cache vs ~1.2 GB
     # int8 of weights per step — DECODE_r04.md); jnp.bfloat16 halves that
     # traffic. Opt-in because it rounds stored K/V: greedy tokens can
-    # diverge from the f32-cache reference at near-ties (scores still
-    # accumulate f32 — masked_attention's preferred_element_type).
+    # diverge from the f32-cache reference at near-ties (both attention
+    # matmuls still accumulate f32 — masked_attention sets
+    # preferred_element_type on the scores AND the context einsum — so
+    # the only loss is the storage rounding itself).
     kv_cache_dtype: "jnp.dtype | None" = None
     # Tensor-parallel int8 serving: a mesh with a 'model' axis routes every
     # quantized matmul through the shard_map-wrapped kernel
@@ -144,8 +146,12 @@ def masked_attention(
     decode (prefix mask).
 
     Scores accumulate in float32 on the MXU (``preferred_element_type``), the
-    softmax runs in float32, and the context matmul returns to the compute
-    dtype — the TPU mixed-precision idiom.
+    softmax runs in float32, and the context matmul ALSO accumulates f32
+    (its inputs are the storage dtype — with ``kv_cache_dtype`` set that
+    is the cache dtype, so without the accumulator override the attention
+    output itself would round to the cache dtype, not just stored K/V)
+    before returning to the query compute dtype — the TPU mixed-precision
+    idiom.
     """
     d = q.shape[-1]
     scores = jnp.einsum(
@@ -153,7 +159,10 @@ def masked_attention(
     ) / jnp.sqrt(jnp.float32(d))
     scores = jnp.where(mask, scores, jnp.float32(-1e30))
     weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+    ctx = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights, v, preferred_element_type=jnp.float32
+    )
+    return ctx.astype(q.dtype)
 
 
 def grouped_masked_attention(
@@ -177,8 +186,10 @@ def grouped_masked_attention(
     ) / jnp.sqrt(jnp.float32(d))
     scores = jnp.where(mask[:, :, None], scores, jnp.float32(-1e30))
     weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bcgql,blcd->bqcgd", weights, v)
-    return out.reshape(b, qlen, h, d)
+    out = jnp.einsum(
+        "bcgql,blcd->bqcgd", weights, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype).reshape(b, qlen, h, d)
 
 
 def _expand_kv(kv: jax.Array, n_heads: int) -> jax.Array:

@@ -292,3 +292,87 @@ def test_greedy_ignores_filter_args_in_compile_cache():
                      top_p=0.9)
     assert _compiled_generate.cache_info().currsize == size_after_first
     np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+
+def _sorted_reference_filter(logits, top_k, top_p):
+    """The textbook sorted implementation (what _filter_logits computed
+    before the lax.top_k rewrite) — the parity oracle for the sort-free
+    version."""
+    logits = np.asarray(logits, np.float32).copy()
+    if 0 < top_k < logits.shape[-1]:
+        kth = np.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = np.where(logits < kth, -np.inf, logits)
+    if top_p < 1.0:
+        s = -np.sort(-logits, axis=-1)
+        e = np.exp(s - s[..., :1])
+        probs = e / e.sum(axis=-1, keepdims=True)
+        cum = np.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p
+        cutoff = np.min(np.where(keep, s, np.inf), axis=-1, keepdims=True)
+        logits = np.where(logits < cutoff, -np.inf, logits)
+    return logits
+
+
+@pytest.mark.parametrize("top_k,top_p", [
+    (0, 0.9), (0, 0.3), (5, 1.0), (5, 0.7), (17, 0.95), (0, 0.999),
+])
+def test_filter_logits_matches_sorted_reference(top_k, top_p):
+    """The lax.top_k-based filters are draw-for-draw identical to the
+    full-sort textbook implementation whenever the nucleus fits in the
+    candidate budget (always at this vocab: V=97 < _NUCLEUS_CANDIDATES)."""
+    from pytorch_distributed_training_tutorials_tpu.models.generate import _filter_logits
+
+    rng = np.random.Generator(np.random.PCG64(3))
+    logits = jnp.asarray(rng.normal(size=(4, 97)) * 3.0, jnp.float32)
+    got = np.asarray(_filter_logits(logits, top_k=top_k, top_p=top_p))
+    want = _sorted_reference_filter(logits, top_k, top_p)
+    # identical support and identical surviving values
+    np.testing.assert_array_equal(np.isfinite(got), np.isfinite(want))
+    np.testing.assert_allclose(
+        got[np.isfinite(got)], want[np.isfinite(want)], rtol=1e-6
+    )
+
+
+def test_filter_logits_compiles_without_full_vocab_sort():
+    """VERDICT r04 #5: at a real vocab the per-step O(V log V) sorts
+    rivaled the lm_head matmul. The filters must lower through lax.top_k
+    (a partial top-k selection), never the sort primitive — asserted on
+    the jaxpr, which is backend-independent (on CPU the TopK custom call
+    may itself expand to a sort during XLA lowering; the contract here is
+    that *we* never request a full-vocabulary sort)."""
+    from pytorch_distributed_training_tutorials_tpu.models.generate import _filter_logits
+
+    logits = jnp.zeros((2, 32768), jnp.float32)
+    for kw in (dict(top_k=50, top_p=0.9), dict(top_k=0, top_p=0.9),
+               dict(top_k=50, top_p=1.0)):
+        jaxpr = jax.make_jaxpr(
+            lambda x, kw=kw: _filter_logits(x, **kw)
+        )(logits)
+        prims = {eqn.primitive.name for eqn in jaxpr.jaxpr.eqns}
+        assert "sort" not in prims, (kw, prims)
+        assert any("top_k" in p for p in prims), (kw, prims)
+
+
+def test_filter_logits_nucleus_cap_degrades_to_top_cap():
+    """When the nucleus needs more than _NUCLEUS_CANDIDATES tokens (flat
+    distribution over a big vocab), the filter degrades to an implicit
+    top-cap cut: exactly the cap's worth of (highest) tokens survive, and
+    their values are untouched — the documented approximation, pinned."""
+    import importlib
+
+    G = importlib.import_module(
+        "pytorch_distributed_training_tutorials_tpu.models.generate"
+    )
+
+    v = 4 * G._NUCLEUS_CANDIDATES
+    rng = np.random.Generator(np.random.PCG64(9))
+    # near-uniform: nucleus at p=0.99 would need ~0.99*V >> cap tokens
+    logits = jnp.asarray(rng.normal(size=(1, v)) * 1e-3, jnp.float32)
+    out = np.asarray(G._filter_logits(logits, top_k=0, top_p=0.99))
+    kept = np.isfinite(out[0])
+    assert kept.sum() == G._NUCLEUS_CANDIDATES
+    # the survivors are the top-cap tokens, values preserved
+    order = np.argsort(-np.asarray(logits[0]))
+    np.testing.assert_array_equal(np.sort(np.nonzero(kept)[0]),
+                                  np.sort(order[:G._NUCLEUS_CANDIDATES]))
+    np.testing.assert_array_equal(out[0][kept], np.asarray(logits)[0][kept])
