@@ -176,6 +176,13 @@ def main():
     ap.add_argument("--tp", type=int, default=1,
                     help="model-axis width for sharded int8 serving")
     ap.add_argument("--ckpt_dir", default=None)
+    ap.add_argument(
+        "--hf_checkpoint", default=None, metavar="DIR",
+        help="serve a published HF-layout Llama checkpoint (config.json "
+        "+ *.safetensors) instead of the synthetic orbax one: streamed "
+        "tensor-by-tensor and quantized on load (parallel.hf_llama) — "
+        "the from_pretrained(load_in_8bit=True) path, offline",
+    )
     ap.add_argument("--prompt_len", type=int, default=32)
     ap.add_argument("--new_tokens", type=int, default=32)
     ap.add_argument("--batch", type=int, default=4)
@@ -238,6 +245,12 @@ def main():
     from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
 
     cfg = presets()[args.preset]
+    if args.hf_checkpoint:
+        from pytorch_distributed_training_tutorials_tpu.parallel.hf_llama import (
+            config_from_hf,
+        )
+
+        cfg = config_from_hf(args.hf_checkpoint)
     if args.max_seq_len is not None:
         # params are window-agnostic: only the cache shapes and the RoPE
         # offsets derive from max_seq_len, so the same checkpoint serves
@@ -261,7 +274,15 @@ def main():
 
     t0 = time.perf_counter()
     receipt = {"preset": args.preset, "tp": args.tp}
-    if not os.path.isfile(os.path.join(ckpt, "COMPLETE")):
+    if args.hf_checkpoint:
+        receipt["hf_checkpoint"] = os.path.abspath(args.hf_checkpoint)
+        receipt["preset"] = "hf"
+        n_params = count_params(cfg)
+        receipt["n_params"] = n_params
+        receipt["checkpoint_gb_f32"] = round(4 * n_params / 1e9, 2)
+        print(f"checkpoint: HF layout at {args.hf_checkpoint} "
+              f"({n_params/1e9:.2f}B params)")
+    elif not os.path.isfile(os.path.join(ckpt, "COMPLETE")):
         n_params = write_synthetic_checkpoint(cfg, ckpt)
         receipt["n_params"] = n_params
         receipt["checkpoint_gb_f32"] = round(4 * n_params / 1e9, 2)
@@ -279,9 +300,29 @@ def main():
         receipt["checkpoint_reused"] = True
         print(f"checkpoint: reusing {ckpt}")
 
+    scan_layers = not args.unrolled
     rss_before = rss_gb()
     t0 = time.perf_counter()
-    params = load_streamed(cfg, ckpt, mesh)
+    if args.hf_checkpoint:
+        from pytorch_distributed_training_tutorials_tpu.parallel.hf_llama import (
+            load_hf_llama,
+        )
+
+        if mesh is not None and not scan_layers:
+            raise SystemExit(
+                "--hf_checkpoint with --tp requires the scanned layout "
+                "(drop --unrolled): tensor-parallel placement of HF "
+                "weights runs through place_int8_lm_params on the "
+                "stacked tree"
+            )
+        # materialize=False: main() device-materializes ONCE after
+        # placement below, same as the orbax path
+        _, params = load_hf_llama(
+            args.hf_checkpoint, cfg=cfg, quantize=True,
+            scan_layers=scan_layers, materialize=False,
+        )
+    else:
+        params = load_streamed(cfg, ckpt, mesh)
     n_bytes = sum(
         l.size * l.dtype.itemsize
         for l in jax.tree_util.tree_leaves(params)
@@ -305,7 +346,6 @@ def main():
         f"f32 tree would be {f32_gb:.1f} GB)"
     )
 
-    scan_layers = not args.unrolled
     if scan_layers:
         # one scanned block body instead of n_layers unrolled copies:
         # O(1) program size in depth. On this tunneled runtime the
@@ -315,7 +355,8 @@ def main():
             stack_quantized_lm_params,
         )
 
-        params = stack_quantized_lm_params(params)
+        if not args.hf_checkpoint:  # the HF loader stacked already
+            params = stack_quantized_lm_params(params)
         if mesh is not None:
             from pytorch_distributed_training_tutorials_tpu.models.transformer import (
                 place_int8_lm_params,

@@ -52,6 +52,10 @@ class TransformerConfig:
     max_seq_len: int = 512
     dtype: jnp.dtype = jnp.float32
     rope_theta: float = 10000.0
+    # RMSNorm epsilon. 1e-6 is the Llama-1 value; published Llama-2/3
+    # checkpoints (parallel/hf_llama.py ingestion) use 1e-5 — exact logit
+    # parity with the source model requires matching it.
+    norm_eps: float = 1e-6
     scan_layers: bool = False
     remat: bool = False
     # attention_fn(q, k, v) -> out, all (B, S, H, D), causal semantics.
@@ -381,7 +385,7 @@ class Block(nn.Module):
     def __call__(self, x, decode: bool = False, prefill: bool = False):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(name="attn_norm")(x), decode=decode, prefill=prefill
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), decode=decode, prefill=prefill
         )
         if cfg.moe_experts > 0:
             ffn = MoEFFN(
@@ -395,7 +399,7 @@ class Block(nn.Module):
             )
         else:
             ffn = SwiGLU(cfg, name="mlp")
-        return x + ffn(RMSNorm(name="mlp_norm")(x))
+        return x + ffn(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
 
 
 class _ScanCell(nn.Module):
@@ -461,7 +465,7 @@ class TransformerLM(nn.Module):
             # skip the (P-1) discarded lm_head rows — at serving widths the
             # head is the single largest matmul in the prefill
             x = x[:, -1:]
-        x = RMSNorm(name="final_norm")(x)
+        x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if cfg.quantized:
             from pytorch_distributed_training_tutorials_tpu.ops.quant import Int8Dense
 

@@ -202,7 +202,7 @@ class PipelinedTransformerLM:
         x_mb = x.reshape(m, b // m, *x.shape[1:])
         y_mb = self._pipeline(params["layers"]["block"], x_mb)
         y = y_mb.reshape(b, *x.shape[1:])
-        y = RMSNorm().apply({"params": params["final_norm"]}, y)
+        y = RMSNorm(cfg.norm_eps).apply({"params": params["final_norm"]}, y)
         return nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=cfg.dtype
         ).apply({"params": params["lm_head"]}, y)

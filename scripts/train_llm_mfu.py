@@ -1,0 +1,325 @@
+"""LM train-step MFU on the real chip — the TRAIN_LLM_r05 receipt.
+
+The round-4 verdict: the framework's deepest asset is the transformer
+stack, yet the only measured training MFU was conv-bound ResNet (57%,
+architecture-capped). This script measures what fraction of the v5e's
+197 bf16 TFLOP/s a full `TransformerLM` train step achieves — the
+standard headline metric for a distributed-training framework — and
+sweeps the knobs that move it (remat, attention kernel + block sizes,
+batch, sequence length).
+
+Methodology (per CLAUDE.md's tunnel rules):
+- the measured program is a jitted ``lax.scan`` chain of N train steps on
+  a cached device-resident batch — ONE launch + ONE terminal fetch, so
+  the ~75-130 ms per-launch tunnel cost amortizes to noise;
+- wall time is min-of-3 with a real scalar fetch closing each run;
+- FLOPs come two ways and both are reported:
+  * **model FLOPs** (the MFU numerator, PaLM convention): ``6*N_params``
+    per token for the matmuls + ``12*L*d_model*S`` per token for
+    attention scores/context (no causality discount) — remat recompute
+    does NOT count, so remat honestly lowers MFU unless it buys a bigger
+    batch;
+  * **executed FLOPs** from XLA's cost analysis (hardware utilization —
+    counts recompute, so it exceeds model FLOPs under remat);
+- ``--trace`` captures a device trace of the chain and reports the
+  trace-summed device time (the launch-free ground truth) alongside wall.
+
+Run on the real chip:
+
+    python scripts/train_llm_mfu.py --sweep --json TRAIN_LLM_r05.json
+    python scripts/train_llm_mfu.py --preset 350m --remat --trace
+
+CPU smoke (tiny shapes, correctness of the harness only):
+
+    JAX_PLATFORMS=cpu python scripts/train_llm_mfu.py --preset smoke --steps 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PEAK_BF16 = 197e12  # TPU v5e lite chip peak, bf16
+
+PRESETS = {
+    # name: (d_model, n_layers, n_heads, vocab)
+    "smoke": (64, 2, 4, 256),
+    "125m": (768, 12, 12, 32768),
+    "350m": (1024, 24, 16, 32768),
+    "760m": (1536, 24, 16, 32768),
+}
+
+
+def model_flops_per_token(n_params_nonembed: int, d_model: int,
+                          n_layers: int, seq_len: int) -> float:
+    """Training FLOPs per token, PaLM appendix-B convention: 6x the
+    non-embedding params (fwd 2x + bwd 4x) plus 12*L*d*S for the two
+    attention einsums (QK^T and weights@V, fwd+bwd)."""
+    return 6.0 * n_params_nonembed + 12.0 * n_layers * d_model * seq_len
+
+
+def build(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pytorch_distributed_training_tutorials_tpu.models import (
+        TransformerConfig, TransformerLM,
+    )
+    from pytorch_distributed_training_tutorials_tpu.ops.flash_attention import (
+        make_flash_attention,
+    )
+    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
+        TrainState, _train_step_fn,
+    )
+
+    d_model, n_layers, n_heads, vocab = PRESETS[args.preset]
+    attention_fn = None
+    if args.attn == "flash":
+        attention_fn = make_flash_attention(args.block_q, args.block_k)
+    cfg = TransformerConfig(
+        vocab_size=vocab,
+        d_model=d_model,
+        n_layers=n_layers,
+        n_heads=n_heads,
+        max_seq_len=args.seq,
+        dtype=jnp.bfloat16,
+        scan_layers=True,
+        remat=args.remat,
+        attention_fn=attention_fn,
+    )
+    model = TransformerLM(cfg)
+    key = jax.random.PRNGKey(0)
+    params = jax.jit(model.init)(key, jnp.zeros((1, args.seq), jnp.int32))[
+        "params"
+    ]
+    tx = optax.adamw(3e-4, weight_decay=0.01)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    toks = jnp.asarray(
+        rng.integers(0, vocab, (args.batch, args.seq + 1)), jnp.int32
+    )
+    batch = (toks[:, :-1], toks[:, 1:])
+    step_fn = _train_step_fn("cross_entropy", has_batch_stats=False)
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    # embedding + lm_head don't do 6N of matmul work per token
+    n_embed = vocab * d_model  # tok_emb; lm_head IS a matmul, keep it
+    return model, state, batch, step_fn, n_params, n_embed
+
+
+def chain_fn(step_fn, batch, n_steps):
+    import jax
+
+    def body(state, _):
+        state, metrics = step_fn(state, batch)
+        return state, metrics["loss"]
+
+    # donate the carried state: without aliasing, argument + output trees
+    # double the resident optimizer state (measured: 350m B=4 remat probe
+    # reported 14.9 GiB peak un-donated)
+    @functools.partial(jax.jit, donate_argnums=0)
+    def chain(state):
+        return jax.lax.scan(body, state, None, length=n_steps)
+
+    return chain
+
+
+def measure(args) -> dict:
+    import jax
+
+    t_build = time.perf_counter()
+    model, state, batch, step_fn, n_params, n_embed = build(args)
+    jax.block_until_ready(state.params)
+
+    chain = chain_fn(step_fn, batch, args.steps)
+    compiled = chain.lower(state).compile()
+    compile_s = time.perf_counter() - t_build
+    mem = compiled.memory_analysis()
+    peak_gb = None
+    if mem is not None:
+        peak_gb = round(
+            (
+                getattr(mem, "temp_size_in_bytes", 0)
+                + getattr(mem, "argument_size_in_bytes", 0)
+                + getattr(mem, "output_size_in_bytes", 0)
+                - getattr(mem, "alias_size_in_bytes", 0)
+            )
+            / 2**30,
+            2,
+        )
+        print(f"# peak HBM (XLA estimate): {peak_gb} GiB", file=sys.stderr)
+        if args.mem_only:
+            return {
+                "preset": args.preset, "seq": args.seq,
+                "batch": args.batch, "attn": args.attn,
+                "remat": bool(args.remat), "peak_hbm_gib": peak_gb,
+                "compile_s": round(compile_s, 1),
+            }
+
+    # executed FLOPs from XLA's own cost model (single un-scanned step so
+    # scan-length bookkeeping can't distort it)
+    cost = (
+        jax.jit(step_fn).lower(state, batch).compile().cost_analysis()
+    )
+    executed_flops = float(cost.get("flops", 0.0))
+
+    d_model, n_layers, _, vocab = PRESETS[args.preset]
+    tokens_per_step = args.batch * args.seq
+    # lm_head participates in the 6N term; only tok_emb is excluded
+    mflops_tok = model_flops_per_token(
+        n_params - n_embed, d_model, n_layers, args.seq
+    )
+    model_flops = mflops_tok * tokens_per_step
+
+    # prime the process's first D2H fetch outside every timed region
+    state2, losses = compiled(state)
+    float(losses[-1])
+
+    samples = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        state2, losses = compiled(state2)
+        float(losses[-1])  # close the region with a real fetch
+        samples.append(time.perf_counter() - t0)
+    wall = min(samples)
+    step_s = wall / args.steps
+
+    out = {
+        "preset": args.preset,
+        "d_model": d_model,
+        "n_layers": n_layers,
+        "vocab": vocab,
+        "seq": args.seq,
+        "batch": args.batch,
+        "attn": args.attn
+        + (f"({args.block_q},{args.block_k})" if args.attn == "flash" else ""),
+        "remat": bool(args.remat),
+        "n_params": n_params,
+        "steps_chained": args.steps,
+        "wall_s_samples": [round(s, 3) for s in samples],
+        "step_ms": round(step_s * 1e3, 2),
+        "tokens_per_s": round(tokens_per_step / step_s),
+        "model_tflops_per_step": round(model_flops / 1e12, 3),
+        "executed_tflops_per_step": round(executed_flops / 1e12, 3),
+        "mfu": round(model_flops / step_s / PEAK_BF16, 4),
+        "hw_util_executed": round(executed_flops / step_s / PEAK_BF16, 4),
+        "compile_s": round(compile_s, 1),
+        "peak_hbm_gib": peak_gb,
+        "backend": jax.default_backend(),
+    }
+
+    if args.trace:
+        import shutil
+
+        from pytorch_distributed_training_tutorials_tpu.utils import profiling
+
+        logdir = "/tmp/jax-trace-lm"
+        shutil.rmtree(logdir, ignore_errors=True)
+        with profiling.trace(logdir):
+            state2, losses = compiled(state2)
+            float(losses[-1])
+        durations = profiling.device_op_durations(logdir)
+        leaf_us = sum(
+            v
+            for k, v in durations.items()
+            if not (
+                k.startswith("jit_") or k.startswith("while") or k.isdigit()
+            )
+        )
+        dev_step_s = leaf_us / 1e6 / args.steps
+        out["trace_step_ms"] = round(dev_step_s * 1e3, 2)
+        out["trace_mfu"] = round(model_flops / dev_step_s / PEAK_BF16, 4)
+        out["trace_hw_util"] = round(
+            executed_flops / dev_step_s / PEAK_BF16, 4
+        )
+    return out
+
+
+def parse(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--preset", choices=sorted(PRESETS), default="350m")
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--attn", choices=["dense", "flash"], default="flash")
+    p.add_argument("--block_q", type=int, default=512)
+    p.add_argument("--block_k", type=int, default=512)
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--steps", type=int, default=8,
+                   help="steps per compiled lax.scan chain")
+    p.add_argument("--reps", type=int, default=3, help="min-of-N chain runs")
+    p.add_argument("--trace", action="store_true",
+                   help="capture a device trace of one chain run")
+    p.add_argument("--mem_only", action="store_true",
+                   help="compile and report XLA peak-memory estimate only")
+    p.add_argument("--sweep", action="store_true",
+                   help="run the round-5 tuning table instead of one point")
+    p.add_argument("--json", default=None, help="write results JSON here")
+    return p.parse_args(argv)
+
+
+# Memory-feasible grid (probed with --mem_only on the v5e's 15.75 GiB
+# HBM: 350m B=8 remat 10.8 GiB, B=16 remat 14.1 GiB; B=8 WITHOUT remat
+# needs 32.5 GiB — no-remat only fits at toy batch, so remat is not a
+# tuning choice at this scale, it is the enabler of real batch sizes).
+SWEEP = [
+    # (preset, seq, batch, attn, block_q, block_k, remat)
+    ("350m", 2048, 8, "flash", 512, 512, True),
+    ("350m", 2048, 8, "flash", 1024, 512, True),
+    ("350m", 2048, 8, "flash", 2048, 512, True),
+    ("350m", 2048, 8, "flash", 512, 1024, True),
+    ("350m", 2048, 8, "dense", 0, 0, True),
+    ("350m", 2048, 2, "flash", 512, 512, False),
+    ("350m", 2048, 16, "flash", 512, 512, True),
+    ("350m", 4096, 4, "flash", 512, 512, True),
+    ("125m", 2048, 16, "flash", 512, 512, True),
+    ("760m", 2048, 4, "flash", 512, 512, True),
+]
+
+
+def main() -> None:
+    args = parse()
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    results = []
+    if args.sweep:
+        for preset, seq, batch, attn, bq, bk, remat in SWEEP:
+            a = argparse.Namespace(**vars(args))
+            a.preset, a.seq, a.batch, a.attn = preset, seq, batch, attn
+            a.block_q, a.block_k, a.remat = bq, bk, remat
+            try:
+                r = measure(a)
+            except Exception as e:  # OOM points are data, not crashes
+                r = {
+                    "preset": preset, "seq": seq, "batch": batch,
+                    "attn": attn, "remat": remat,
+                    "error": f"{type(e).__name__}: {str(e)[:200]}",
+                }
+            results.append(r)
+            print(json.dumps(r))
+    else:
+        r = measure(args)
+        results.append(r)
+        print(json.dumps(r, indent=2))
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+            f.write("\n")
+        print(f"results -> {args.json}")
+
+
+if __name__ == "__main__":
+    main()

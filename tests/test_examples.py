@@ -70,3 +70,29 @@ def test_int8_serving_long_context_flash(tmp_path):
     ])
     assert out2.returncode == 0, out2.stderr[-2000:]
     assert "serve:" in out2.stdout
+
+
+@pytest.mark.slow
+def test_int8_serving_from_hf_checkpoint(tmp_path):
+    """--hf_checkpoint serves a published-format (HF safetensors) Llama
+    directory through the same quantize-on-load pipeline — the
+    from_pretrained(load_in_8bit=True) twin, offline end to end."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+    )
+    torch.manual_seed(0)
+    transformers.LlamaForCausalLM(cfg).save_pretrained(
+        str(tmp_path), safe_serialization=True
+    )
+    out = _run([
+        "examples/serve_llm_int8.py", "--hf_checkpoint", str(tmp_path),
+        "--prompt_len", "8", "--new_tokens", "4", "--batch", "2",
+    ])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "HF layout" in out.stdout and "serve:" in out.stdout
