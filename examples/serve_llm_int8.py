@@ -208,11 +208,12 @@ def main():
     ap.add_argument("--top_p", type=float, default=1.0,
                     help="nucleus sampling mass when sampling")
     ap.add_argument(
-        "--kv_cache_dtype", choices=("f32", "bf16"), default="f32",
-        help="KV-cache storage dtype: bf16 halves per-step cache traffic "
-        "(decode at long windows is cache-bound, DECODE_r04.md) at the "
-        "cost of rounding stored K/V — greedy tokens can diverge at "
-        "near-ties",
+        "--kv_cache_dtype", choices=("f32", "bf16", "int8"), default="f32",
+        help="KV-cache storage dtype: bf16 halves per-step cache traffic, "
+        "int8 quarters it (per-token absmax scales stored alongside) — "
+        "decode at long windows is cache-bound (DECODE_r04.md); reduced "
+        "dtypes round stored K/V, so greedy tokens can diverge at "
+        "near-ties (int8 more than bf16)",
     )
     ap.add_argument(
         "--flash", action="store_true",
@@ -260,10 +261,15 @@ def main():
         from pytorch_distributed_training_tutorials_tpu.ops import flash_attention
 
         cfg = dataclasses.replace(cfg, attention_fn=flash_attention)
-    if args.kv_cache_dtype == "bf16":
+    if args.kv_cache_dtype != "f32":
         import jax.numpy as _jnp
 
-        cfg = dataclasses.replace(cfg, kv_cache_dtype=_jnp.bfloat16)
+        cfg = dataclasses.replace(
+            cfg,
+            kv_cache_dtype=(
+                _jnp.bfloat16 if args.kv_cache_dtype == "bf16" else _jnp.int8
+            ),
+        )
     ckpt = args.ckpt_dir or os.path.join(
         os.environ.get("TMPDIR", "/tmp"), f"llm_int8_{args.preset}"
     )

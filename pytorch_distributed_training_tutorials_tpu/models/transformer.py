@@ -58,6 +58,15 @@ class TransformerConfig:
     norm_eps: float = 1e-6
     scan_layers: bool = False
     remat: bool = False
+    # What remat may KEEP instead of recomputing (jax.checkpoint policy):
+    # None = full remat (recompute everything in the block — minimum HBM,
+    # ~1/3 extra matmul FLOPs in the backward); "dots" =
+    # checkpoint_dots_with_no_batch_dims_saveable (save matmul outputs,
+    # recompute only the cheap elementwise/norm ops — the standard LLM
+    # trade: backward matmul recompute disappears for ~2x the activation
+    # footprint of full remat). Measured on the v5e (TRAIN_LLM_r05.md):
+    # "dots" lifts the 350m train step's MFU materially over full remat.
+    remat_policy: str | None = None
     # attention_fn(q, k, v) -> out, all (B, S, H, D), causal semantics.
     # None = dense causal softmax attention on-device.
     attention_fn: Callable | None = None
@@ -78,11 +87,13 @@ class TransformerConfig:
     # At long windows decode is CACHE-bound, not weight-bound (the 1b
     # preset at a 2080-token window reads ~2.2 GB f32 of cache vs ~1.2 GB
     # int8 of weights per step — DECODE_r04.md); jnp.bfloat16 halves that
-    # traffic. Opt-in because it rounds stored K/V: greedy tokens can
-    # diverge from the f32-cache reference at near-ties (both attention
-    # matmuls still accumulate f32 — masked_attention sets
-    # preferred_element_type on the scores AND the context einsum — so
-    # the only loss is the storage rounding itself).
+    # traffic, and jnp.int8 quarters it (per-token-per-head absmax scales
+    # stored alongside — _quantize_kv — at ~1.06 bytes/element all-in).
+    # Opt-in because it rounds stored K/V: greedy tokens can diverge from
+    # the f32-cache reference at near-ties (both attention matmuls still
+    # accumulate f32 — masked_attention sets preferred_element_type on
+    # the scores AND the context einsum — so the only loss is the storage
+    # rounding itself; int8 rounds harder than bf16).
     kv_cache_dtype: "jnp.dtype | None" = None
     # Tensor-parallel int8 serving: a mesh with a 'model' axis routes every
     # quantized matmul through the shard_map-wrapped kernel
@@ -196,6 +207,26 @@ def grouped_masked_attention(
     return out.astype(q.dtype).reshape(b, qlen, h, d)
 
 
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Quantize K/V ``(B, S, H, D)`` to int8 with per-(B, S, H) float32
+    scales (absmax over the head_dim vector — each stored token/head gets
+    its own scale, so one outlier token cannot crush every other's
+    resolution). Inverse: :func:`_dequantize_kv`."""
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.round(x32 / scale[..., None]).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """int8 cache + scales -> compute dtype. XLA fuses this elementwise
+    expansion into the attention matmuls' operand reads, so HBM traffic
+    per decode step stays at the int8+scale footprint (~1.06 bytes per
+    cached element vs 2 bf16 / 4 f32)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def _expand_kv(kv: jax.Array, n_heads: int) -> jax.Array:
     """Repeat grouped K/V heads up to the query head count (GQA -> MHA
     view); identity when the counts already match."""
@@ -220,7 +251,10 @@ class Attention(nn.Module):
         prefill branches (shapes/dtypes must agree or decode misreads what
         prefill wrote). Only ``kv_heads`` heads are cached (GQA);
         ``cfg.kv_cache_dtype`` overrides the storage dtype (long-window
-        decode is cache-traffic-bound — see the config field)."""
+        decode is cache-traffic-bound — see the config field). int8
+        storage additionally carries per-(batch, position, head) float32
+        scales (absmax over head_dim — the same per-channel scheme
+        ops.quant uses for weights); scale vars are ``None`` otherwise."""
         cfg = self.cfg
         h, d = cfg.kv_heads, cfg.head_dim
         if cfg.kv_cache_dtype is not None:
@@ -237,7 +271,17 @@ class Attention(nn.Module):
             "cache", "cache_index",
             lambda: jnp.zeros((), jnp.int32),
         )
-        return cached_k, cached_v, idx
+        k_scale = v_scale = None
+        if k_dtype == jnp.int8:
+            k_scale = self.variable(
+                "cache", "cached_key_scale",
+                jnp.zeros, (b, cfg.max_seq_len, h), jnp.float32,
+            )
+            v_scale = self.variable(
+                "cache", "cached_value_scale",
+                jnp.zeros, (b, cfg.max_seq_len, h), jnp.float32,
+            )
+        return cached_k, cached_v, idx, k_scale, v_scale
 
     @nn.compact
     def __call__(self, x, decode: bool = False, prefill: bool = False):
@@ -285,18 +329,44 @@ class Attention(nn.Module):
             # would need its own decode rule.
             b = x.shape[0]
             assert x.shape[1] == 1, "decode=True expects one token at a time"
-            cached_k, cached_v, idx = self._cache_vars(
+            cached_k, cached_v, idx, k_scale, v_scale = self._cache_vars(
                 b, k_raw.dtype, v.dtype
             )
             pos = idx.value
             q = apply_rope(q_raw, cfg.rope_theta, offset=pos)
             k = apply_rope(k_raw, cfg.rope_theta, offset=pos)
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(cached_k.value.dtype), (0, pos, 0, 0)
-            )
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(cached_v.value.dtype), (0, pos, 0, 0)
-            )
+            if k_scale is not None:  # int8 cache: store q + scale
+                k_q, k_s = _quantize_kv(k)
+                v_q, v_s = _quantize_kv(v)
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k_q, (0, pos, 0, 0)
+                )
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v_q, (0, pos, 0, 0)
+                )
+                k_scale.value = jax.lax.dynamic_update_slice(
+                    k_scale.value, k_s, (0, pos, 0)
+                )
+                v_scale.value = jax.lax.dynamic_update_slice(
+                    v_scale.value, v_s, (0, pos, 0)
+                )
+                k_read = _dequantize_kv(
+                    cached_k.value, k_scale.value, k.dtype
+                )
+                v_read = _dequantize_kv(
+                    cached_v.value, v_scale.value, v.dtype
+                )
+            else:
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k.astype(cached_k.value.dtype),
+                    (0, pos, 0, 0)
+                )
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v.astype(cached_v.value.dtype),
+                    (0, pos, 0, 0)
+                )
+                k_read = cached_k.value
+                v_read = cached_v.value
             idx.value = pos + 1
             # attend over the whole cache, masking positions beyond `pos`;
             # same math as training/prefill. GQA: the cache holds kv_heads
@@ -304,7 +374,7 @@ class Attention(nn.Module):
             # traffic scales with n_kv_heads, the point of the layout
             valid = jnp.arange(cfg.max_seq_len) <= pos  # (max_len,)
             out = grouped_masked_attention(
-                q, cached_k.value, cached_v.value,
+                q, k_read, v_read,
                 valid[None, None, None, :],
             )
         else:
@@ -318,17 +388,33 @@ class Attention(nn.Module):
                 # (generate() drives this; the one-token path self-documents
                 # the contract)
                 b, s = x.shape[0], x.shape[1]
-                cached_k, cached_v, idx = self._cache_vars(
+                cached_k, cached_v, idx, k_scale, v_scale = self._cache_vars(
                     b, k_raw.dtype, v.dtype
                 )
-                cached_k.value = jax.lax.dynamic_update_slice(
-                    cached_k.value, k.astype(cached_k.value.dtype),
-                    (0, 0, 0, 0)
-                )
-                cached_v.value = jax.lax.dynamic_update_slice(
-                    cached_v.value, v.astype(cached_v.value.dtype),
-                    (0, 0, 0, 0)
-                )
+                if k_scale is not None:  # int8 cache
+                    k_q, k_s = _quantize_kv(k)
+                    v_q, v_s = _quantize_kv(v)
+                    cached_k.value = jax.lax.dynamic_update_slice(
+                        cached_k.value, k_q, (0, 0, 0, 0)
+                    )
+                    cached_v.value = jax.lax.dynamic_update_slice(
+                        cached_v.value, v_q, (0, 0, 0, 0)
+                    )
+                    k_scale.value = jax.lax.dynamic_update_slice(
+                        k_scale.value, k_s, (0, 0, 0)
+                    )
+                    v_scale.value = jax.lax.dynamic_update_slice(
+                        v_scale.value, v_s, (0, 0, 0)
+                    )
+                else:
+                    cached_k.value = jax.lax.dynamic_update_slice(
+                        cached_k.value, k.astype(cached_k.value.dtype),
+                        (0, 0, 0, 0)
+                    )
+                    cached_v.value = jax.lax.dynamic_update_slice(
+                        cached_v.value, v.astype(cached_v.value.dtype),
+                        (0, 0, 0, 0)
+                    )
                 idx.value = jnp.asarray(s, jnp.int32)
             attn = (
                 cfg.attention_fn
@@ -341,6 +427,16 @@ class Attention(nn.Module):
             k_attn = _expand_kv(k, h)
             v_attn = _expand_kv(v, h)
             div = getattr(attn, "requires_seq_divisible", 0)
+            if not decode and not prefill:
+                # tag for remat_policy="dots_attn": saveable across the
+                # block's checkpoint boundary (training path only — the
+                # serving paths never differentiate)
+                from jax.ad_checkpoint import checkpoint_name
+
+                attn_inner = attn
+
+                def attn(q_, k_, v_, _inner=attn_inner):
+                    return checkpoint_name(_inner(q_, k_, v_), "attn_out")
             if prefill and div and x.shape[1] % div:
                 # sequence-parallel schedules (ring/Ulysses) require the
                 # sequence to divide the seq mesh axis; for prompt lengths
@@ -376,6 +472,31 @@ class SwiGLU(nn.Module):
         gate = nn.silu(dense(cfg.ff_dim, "gate_proj", "column")(x))
         up = dense(cfg.ff_dim, "up_proj", "column")(x)
         return dense(cfg.d_model, "down_proj", "row")(gate * up)
+
+
+def _remat_policy(cfg: TransformerConfig):
+    """Resolve ``cfg.remat_policy`` to a jax.checkpoint policy (or None =
+    recompute everything). Unknown names fail loud.
+
+    ``"dots_attn"`` additionally saves the attention output (tagged
+    ``attn_out`` below) — with a Pallas flash kernel the attention is a
+    custom call, not a dot, so plain ``"dots"`` recomputes the whole flash
+    FORWARD inside the backward pass; saving its (B, S, H, D) output
+    trades ~16 MB/layer (350m, B=4) for one fewer kernel invocation per
+    layer per step (TRAIN_LLM_r05.md measures the win)."""
+    if cfg.remat_policy is None:
+        return None
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "dots_attn":
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
+    raise ValueError(
+        f"unknown remat_policy {cfg.remat_policy!r} "
+        "(None, 'dots', or 'dots_attn')"
+    )
 
 
 class Block(nn.Module):
@@ -439,7 +560,9 @@ class TransformerLM(nn.Module):
         if cfg.scan_layers:
             cell = _ScanCell
             if cfg.remat:
-                cell = nn.remat(cell, prevent_cse=False)
+                cell = nn.remat(
+                    cell, prevent_cse=False, policy=_remat_policy(cfg)
+                )
             stack = nn.scan(
                 cell,
                 # 'losses' rides along axis 0 so per-layer sown values (MoE
@@ -454,7 +577,9 @@ class TransformerLM(nn.Module):
             # decode/prefill are Python bools steering cache behavior — they
             # must stay static under remat (args 2/3 of __call__ incl. self)
             block_cls = (
-                nn.remat(Block, static_argnums=(2, 3))
+                nn.remat(
+                    Block, static_argnums=(2, 3), policy=_remat_policy(cfg)
+                )
                 if cfg.remat
                 else Block
             )

@@ -352,3 +352,141 @@ def test_bf16_kv_cache_serving():
     assert out_bf16.max() < cfg.vocab_size
     agree = (out_exact == out_bf16).mean()
     assert agree >= 0.75, f"greedy agreement {agree} vs f32 cache"
+
+
+def test_int8_kv_cache_quant_roundtrip():
+    """_quantize_kv/_dequantize_kv: per-(B,S,H) absmax scales, int8 values,
+    roundtrip error bounded by one quantization step per element."""
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        _dequantize_kv,
+        _quantize_kv,
+    )
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    x = jnp.asarray(rng.standard_normal((2, 6, 3, 16)) * 4.0, jnp.float32)
+    q, scale = _quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 6, 3)
+    back = _dequantize_kv(q, scale, jnp.float32)
+    step = np.asarray(scale)[..., None]  # one LSB per (b, s, h)
+    assert np.max(np.abs(np.asarray(back) - np.asarray(x)) / step) <= 0.5001
+    # an outlier token only affects ITS OWN scale (per-token quantization)
+    x2 = x.at[0, 0, 0, 0].set(1e3)
+    _, scale2 = _quantize_kv(x2)
+    np.testing.assert_allclose(
+        np.asarray(scale2)[1:], np.asarray(scale)[1:], rtol=1e-6
+    )
+
+
+def test_int8_kv_cache_serving():
+    """kv_cache_dtype=int8 quarters cache bytes (per-token scales ride
+    alongside): cache vars must be int8 + f32 scales, prefill and decode
+    must agree on the quantized schema, and greedy generation stays
+    coherent with a high agreement rate vs the exact f32 cache."""
+    cfg, model, params, tokens = _trained_pair()
+    qparams = quantize_lm_params(params)
+    exact = TransformerLM(dataclasses.replace(cfg, quantized=True))
+    q8 = TransformerLM(
+        dataclasses.replace(cfg, quantized=True, kv_cache_dtype=jnp.int8)
+    )
+    _, upd = q8.apply(
+        {"params": qparams}, tokens, prefill=True, mutable=["cache"]
+    )
+    leaves = {
+        "/".join(str(getattr(k, "key", k)) for k in kp): v
+        for kp, v in jax.tree_util.tree_flatten_with_path(upd["cache"])[0]
+    }
+    k_cache = [v for p, v in leaves.items() if p.endswith("cached_key")]
+    k_scales = [
+        v for p, v in leaves.items() if p.endswith("cached_key_scale")
+    ]
+    assert k_cache and all(v.dtype == jnp.int8 for v in k_cache)
+    assert k_scales and all(v.dtype == jnp.float32 for v in k_scales)
+
+    prompt = tokens[:, :4]
+    out_exact = np.asarray(generate(exact, qparams, prompt, max_new_tokens=8))
+    out_i8 = np.asarray(generate(q8, qparams, prompt, max_new_tokens=8))
+    np.testing.assert_array_equal(out_i8[:, :4], np.asarray(prompt))
+    assert out_i8.max() < cfg.vocab_size
+    agree = (out_exact == out_i8).mean()
+    assert agree >= 0.6, f"greedy agreement {agree} vs f32 cache"
+
+
+def test_int8_kv_cache_prefill_matches_stepwise():
+    """One int8-cache prefill must leave the cache SEMANTICALLY equal to P
+    stepwise decodes: the raw int8 codes may differ by a few LSBs (the
+    batched and single-token rope/matmul paths round differently before
+    quantization), so the contract is on the DEQUANTIZED values — equal
+    within a couple of quantization steps — and on cache_index."""
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        _dequantize_kv,
+    )
+
+    cfg, model, params, tokens = _trained_pair()
+    q8cfg = dataclasses.replace(cfg, kv_cache_dtype=jnp.int8)
+    lm = TransformerLM(q8cfg)
+    toks = tokens[:, :6]
+
+    _, pre = lm.apply(
+        {"params": params}, toks, prefill=True, mutable=["cache"]
+    )
+    cache = jax.tree_util.tree_map(
+        jnp.zeros_like,
+        lm.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32), decode=True
+        )["cache"],
+    )
+    for t in range(6):
+        _, upd = lm.apply(
+            {"params": params, "cache": cache},
+            toks[:, t : t + 1],
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = upd["cache"]
+
+    def leaves_by_suffix(tree):
+        return {
+            "/".join(str(getattr(k, "key", k)) for k in kp): v
+            for kp, v in jax.tree_util.tree_flatten_with_path(tree)[0]
+        }
+
+    a, b = leaves_by_suffix(pre["cache"]), leaves_by_suffix(cache)
+    assert a.keys() == b.keys()
+    for path in a:
+        if path.endswith("cache_index"):
+            np.testing.assert_array_equal(np.asarray(a[path]),
+                                          np.asarray(b[path]))
+    for kind in ("key", "value"):
+        for path in a:
+            if not path.endswith(f"cached_{kind}"):
+                continue
+            spath = path + "_scale"
+            da = np.asarray(_dequantize_kv(a[path], a[spath], jnp.float32))
+            db = np.asarray(_dequantize_kv(b[path], b[spath], jnp.float32))
+            lsb = np.maximum(
+                np.asarray(a[spath])[..., None],
+                np.asarray(b[spath])[..., None],
+            )
+            assert np.max(np.abs(da - db) - 2.5 * lsb) <= 0, path
+
+
+def test_int8_kv_cache_composes_with_gqa_and_flash():
+    """The long-context serving stack: GQA (shrunken kv heads) x int8
+    cache x Pallas flash prefill — generate end to end, prompt preserved,
+    agreement with the same model's f32-cache serve."""
+    from pytorch_distributed_training_tutorials_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        max_seq_len=64, attention_fn=flash_attention,
+    )
+    model = TransformerLM(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(3), tokens)["params"]
+    i8 = TransformerLM(dataclasses.replace(cfg, kv_cache_dtype=jnp.int8))
+    out_f32 = np.asarray(generate(model, params, tokens, max_new_tokens=8))
+    out_i8 = np.asarray(generate(i8, params, tokens, max_new_tokens=8))
+    np.testing.assert_array_equal(out_i8[:, :16], np.asarray(tokens))
+    assert (out_f32 == out_i8).mean() >= 0.6
