@@ -51,3 +51,75 @@ def test_sweep_rejects_oversubscription(devices):
 
     with pytest.raises(ValueError, match="exceeds"):
         sweep([16], model=model, tx=tx, make_batch=make_batch)
+
+
+def test_collective_footprint_parses_hlo():
+    """The HLO parser must count collective ops and payload bytes,
+    including tuple-shaped (bucketed) all-reduces."""
+    from pytorch_distributed_training_tutorials_tpu.bench.scaling import (
+        collective_footprint,
+    )
+
+    hlo = """
+HloModule m
+  %ar1 = f32[1024,2]{1,0} all-reduce(%x), replica_groups={}
+  %ar2 = (f32[64]{0}, bf16[32,2]{1,0}) all-reduce(%a, %b)
+  %ag = f32[8,16]{1,0:T(8,128)} all-gather(%y), dimensions={0}
+  %other = f32[4]{0} add(%p, %q)
+"""
+    out = collective_footprint(hlo)
+    assert out["all-reduce"]["ops"] == 2
+    assert out["all-reduce"]["bytes"] == 1024 * 2 * 4 + 64 * 4 + 32 * 2 * 2
+    assert out["all-gather"]["ops"] == 1
+    assert out["all-gather"]["bytes"] == 8 * 16 * 4
+    assert out["total"]["ops"] == 3
+
+    # XLA:TPU's latency-hiding scheduler splits collectives into
+    # -start/-done pairs; payload counts once, on the -start
+    async_hlo = """
+  %ars = f32[1024]{0} all-reduce-start(%x), replica_groups={}
+  %ard = f32[1024]{0} all-reduce-done(%ars)
+"""
+    out = collective_footprint(async_hlo)
+    assert out["all-reduce"]["ops"] == 1
+    assert out["all-reduce"]["bytes"] == 1024 * 4
+
+
+def test_collective_stats_matches_grad_bytes():
+    """The compiled DDP step's all-reduce payload must equal the f32
+    gradient bytes (plus small BN-stat/loss reductions) and be
+    width-independent — the invariant the ring roofline rests on."""
+    from pytorch_distributed_training_tutorials_tpu.bench.scaling import (
+        collective_stats,
+    )
+
+    stats = [
+        collective_stats(w, per_device_batch=8, image_px=28)
+        for w in (2, 4)
+    ]
+    for st in stats:
+        ar = st["collectives"]["all-reduce"]["bytes"]
+        grad = st["f32_grad_bytes"]
+        assert grad <= ar < 1.01 * grad, (ar, grad)
+    assert (
+        stats[0]["collectives"]["all-reduce"]["bytes"]
+        == stats[1]["collectives"]["all-reduce"]["bytes"]
+    )
+
+
+def test_predict_ici_efficiency_bounds():
+    from pytorch_distributed_training_tutorials_tpu.bench.scaling import (
+        predict_ici_efficiency,
+    )
+
+    pred = predict_ici_efficiency(
+        44_700_000, chips=32, step_compute_s=0.01023
+    )
+    assert pred["prediction"] is True
+    assert 0.9 < pred["efficiency_no_overlap"] < 1.0
+    assert pred["efficiency_no_overlap"] <= pred["efficiency_full_overlap"] <= 1.0
+    # tiny compute -> comm-bound -> efficiency collapses (sanity)
+    worse = predict_ici_efficiency(
+        44_700_000, chips=32, step_compute_s=1e-4
+    )
+    assert worse["efficiency_no_overlap"] < 0.2
