@@ -40,6 +40,17 @@ BASELINE_IMAGES_PER_SEC_PER_CHIP = 46400.0
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--real", action="store_true",
+        help="require REAL MNIST on disk (scripts/fetch_datasets.py): "
+        "refuse to bench the synthetic surrogate, so the receipt can "
+        "only be a real-data receipt",
+    )
+    args = ap.parse_args()
+
     import jax
     import time
 
@@ -64,6 +75,12 @@ def main() -> None:
     mesh, ds, loader, trainer = (
         setup.mesh, setup.dataset, setup.loader, setup.trainer
     )
+    if args.real and ds.synthetic:
+        raise SystemExit(
+            "--real: no MNIST idx files under DATA_DIR — run "
+            "scripts/fetch_datasets.py (needs network) first; refusing "
+            "to report a synthetic receipt as real"
+        )
     model = trainer.model
     n_chips = mesh.devices.size
     per_device_batch = setup.per_device_batch

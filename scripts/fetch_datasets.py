@@ -1,0 +1,114 @@
+"""Fetch the real BASELINE datasets (MNIST idx + CIFAR-10) into DATA_DIR.
+
+BASELINE.json's configs name real MNIST / CIFAR-10
+(``/root/repo/BASELINE.json:7-11``); this build environment has no network
+egress, so every committed TPU receipt uses the honestly-labeled synthetic
+surrogate (``mnist().synthetic == True``). A NETWORKED user runs this once
+and the same ``bench.py`` / examples produce the real-data receipt — the
+loaders (``data/datasets.py``) already prefer on-disk files over the
+surrogate; the fixture-tested parse paths (tests/test_real_data_readers.py)
+are exactly what reads these downloads.
+
+Offline behavior: each download failure is reported and skipped (exit 0 —
+a no-op, not an error), so CI and the offline build can run it harmlessly.
+
+    python scripts/fetch_datasets.py            # into $DATA_DIR
+    python scripts/fetch_datasets.py --data_dir /tmp/data
+    DATA_DIR=/tmp/data python bench.py          # real-MNIST headline
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Primary + mirror for each artifact. MNIST's original host
+# (yann.lecun.com) has been flaky for years; ossci-datasets is the
+# torchvision mirror of the same byte-identical files.
+MNIST_FILES = [
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+]
+MNIST_HOSTS = [
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "http://yann.lecun.com/exdb/mnist/",
+]
+CIFAR_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+
+
+def _download(url: str, dest: str, timeout: float) -> bool:
+    tmp = dest + ".part"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r, open(
+            tmp, "wb"
+        ) as f:
+            while True:
+                chunk = r.read(1 << 20)
+                if not chunk:
+                    break
+                f.write(chunk)
+        os.replace(tmp, dest)
+        print(f"  fetched {url} -> {dest}")
+        return True
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        print(f"  offline / unreachable ({type(e).__name__}): {url}")
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def main() -> int:
+    from pytorch_distributed_training_tutorials_tpu.data.datasets import DATA_DIR
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data_dir", default=DATA_DIR)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument(
+        "--skip_cifar", action="store_true",
+        help="MNIST only (the headline workload)",
+    )
+    args = ap.parse_args()
+    os.makedirs(args.data_dir, exist_ok=True)
+
+    got_all = True
+    for fname in MNIST_FILES:
+        dest = os.path.join(args.data_dir, fname)
+        if os.path.exists(dest) or os.path.exists(dest[: -len(".gz")]):
+            print(f"  exists: {fname}")
+            continue
+        if not any(
+            _download(host + fname, dest, args.timeout)
+            for host in MNIST_HOSTS
+        ):
+            got_all = False
+    if not args.skip_cifar:
+        dest = os.path.join(args.data_dir, "cifar-10-python.tar.gz")
+        if os.path.exists(dest) or os.path.isdir(
+            os.path.join(args.data_dir, "cifar-10-batches-py")
+        ):
+            print("  exists: cifar-10-python.tar.gz")
+        elif not _download(CIFAR_URL, dest, args.timeout):
+            got_all = False
+
+    # report what the loaders will now actually serve
+    from pytorch_distributed_training_tutorials_tpu.data import mnist
+
+    real = not mnist("train", data_dir=args.data_dir, raw=True).synthetic
+    print(
+        f"mnist loader now serves: {'REAL data' if real else 'synthetic surrogate'}"
+        + ("" if got_all else " (some downloads failed — offline?)")
+    )
+    return 0  # offline is a no-op, never an error
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
