@@ -328,9 +328,22 @@ class GPipe(ManualPipeline):
       per-stage gradient allreduce over ``data`` is compiled into each
       stage's backward by XLA, exactly as in pure DP.
     - the batch splits into ``num_microbatches`` microbatches that fill and
-      drain the pipeline; stages run concurrently on *different* microbatches
-      (JAX async dispatch schedules the overlap — stage programs live on
-      disjoint devices, so enqueue order is not execution order).
+      drain the pipeline; stage programs live on disjoint device columns, so
+      async dispatch CAN execute different microbatches concurrently — but
+      the schedule itself is PYTHON-DRIVEN: ``train_step`` issues
+      ``(n-1)*m`` forward + ``n*m`` backward stage programs + ``n`` applies
+      as separate XLA launches (pinned by
+      ``tests/test_gpipe.py::test_gpipe_dispatch_count_scales_with_
+      microbatches``), plus a ``device_put`` per microbatch hop. On a
+      runtime whose per-launch cost L is large this floors the step at
+      ~``2*n*m*L`` regardless of compute — the tunneled v5e measures
+      L ~ 75-130 ms (``scripts/launch_overhead_probe.py``), i.e. a
+      2-stage x 4-microbatch step pays ~1-2 s of pure dispatch there.
+      Choose by runtime: homogeneous layer stacks -> :mod:`.pipeline_spmd`
+      (ONE compiled program, microbatching inside ``lax.scan``); direct
+      low-launch-cost hosts with heterogeneous stages -> this class;
+      lesson parity / no microbatching -> :class:`ManualPipeline`
+      (``3n`` launches).
     - gradients (and BatchNorm statistics) accumulate across microbatches
       and apply once per step, averaged — numerically the step is plain
       gradient accumulation, verified against a single-device comparator in

@@ -270,3 +270,44 @@ def test_spmd_pipeline_rejects_bad_configs(dp_pp_mesh):
             dp_pp_mesh,
             num_microbatches=2,
         )
+
+
+def test_gpipe_dispatch_count_scales_with_microbatches(dp_pp_mesh):
+    """Pin GPipe's dispatch model: the heterogeneous schedule is
+    PYTHON-DRIVEN — train_step issues exactly n_stages*m forward and
+    n_stages*m backward stage programs plus n_stages applies (separate
+    XLA launches; microbatch hops add device_puts on top). On a runtime
+    with per-launch cost L this floors a step at ~2*n*m*L regardless of
+    compute (the tunneled v5e measures L ~ 75-130 ms,
+    scripts/launch_overhead_probe.py) — the reason ManualPipeline (no
+    microbatching, 2n+n launches) or the single-program pipeline_spmd
+    (ONE launch) win on high-launch-cost runtimes, and why this schedule
+    claims overlap only from async dispatch, not from fewer programs."""
+    model = resnet18(num_classes=10, stem="cifar")
+    x, y = _tiny_images(n=16)
+    for m in (2, 4):
+        pipe = GPipe.from_linen(
+            model, x, devices=dp_pp_mesh, num_microbatches=m,
+            loss="mse", optimizer=optax.sgd(0.05), seed=0,
+        )
+        counts = {"fwd": 0, "bwd": 0, "apply": 0}
+
+        def wrap(fn, key):
+            def inner(*a, **kw):
+                counts[key] += 1
+                return fn(*a, **kw)
+            return inner
+
+        pipe._fwd = [wrap(f, "fwd") for f in pipe._fwd]
+        pipe._bwd_mid = [wrap(f, "bwd") for f in pipe._bwd_mid]
+        pipe._bwd_last = wrap(pipe._bwd_last, "bwd")
+        real_apply = pipe._apply_stage
+        pipe._apply_stage = wrap(real_apply, "apply")
+
+        pipe.train_step(x, y)
+        n = pipe.num_stages
+        # forward: every microbatch runs stages 0..n-2 eagerly (the last
+        # stage's forward happens inside its bwd program)
+        assert counts["fwd"] == (n - 1) * m, counts
+        assert counts["bwd"] == n * m, counts
+        assert counts["apply"] == n, counts
