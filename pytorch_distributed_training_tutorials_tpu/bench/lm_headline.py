@@ -1,53 +1,41 @@
-"""LM train-step MFU on the real chip — the TRAIN_LLM_r05 receipt.
+"""LM train-step MFU: the transformer training headline (bench leg).
 
-The round-4 verdict: the framework's deepest asset is the transformer
-stack, yet the only measured training MFU was conv-bound ResNet (57%,
-architecture-capped). This script measures what fraction of the v5e's
-197 bf16 TFLOP/s a full `TransformerLM` train step achieves — the
-standard headline metric for a distributed-training framework — and
-sweeps the knobs that move it (remat, attention kernel + block sizes,
-batch, sequence length).
+The ResNet headline (bench.py) is conv-architecture-capped at ~57% MFU
+(PROFILE_r04.md); the standard figure of merit for a distributed-training
+framework is what fraction of peak a TRANSFORMER train step achieves.
+This module owns that measurement — model/batch/attention/remat
+configuration, the one-launch lax.scan chain timing (CLAUDE.md tunnel
+rules), the PaLM-convention model-FLOPs numerator — and a CLI that runs
+the tuned winner and emits a one-line JSON receipt.
 
-Methodology (per CLAUDE.md's tunnel rules):
-- the measured program is a jitted ``lax.scan`` chain of N train steps on
-  a cached device-resident batch — ONE launch + ONE terminal fetch, so
-  the ~75-130 ms per-launch tunnel cost amortizes to noise;
-- wall time is min-of-3 with a real scalar fetch closing each run;
-- FLOPs come two ways and both are reported:
-  * **model FLOPs** (the MFU numerator, PaLM convention): ``6*N_params``
-    per token for the matmuls + ``12*L*d_model*S`` per token for
-    attention scores/context (no causality discount) — remat recompute
-    does NOT count, so remat honestly lowers MFU unless it buys a bigger
-    batch;
-  * **executed FLOPs** from XLA's cost analysis — reported raw but
-    KNOWN LOW on this stack: cost analysis counts a ``while``/scan body
-    once, not times n_layers (measured: 5.4 TF "executed" vs 52.8 TF
-    analytic on the 24-layer 350m step), so ``hw_util_executed`` is not
-    a utilization number when ``scan_layers`` is on;
-- ``--trace`` captures a device trace of the chain and reports the
-  trace-summed device time (the launch-free ground truth) alongside wall.
+Round-5 tuning (TRAIN_LLM_r05.md, measured on the v5e lite chip):
 
-Run on the real chip:
+- Pallas flash attention >> dense at S=2048 (41.5%% vs 24.9%% MFU at the
+  350m scan point) — dense materializes (B, H, S, S) score temps.
+- remat is the ENABLER, not a tax: without it a 350m/B=8 step wants
+  32.5 GiB of activations (15.75 available); remat_policy="dots"
+  (save projection/FFN matmul outputs, recompute elementwise+attention)
+  beats full recompute by ~3 MFU points.
+- UNROLLED layers beat nn.scan for TRAINING here: the scan's stacked
+  activation saves are dynamic-update-slice fusions in awkward layouts —
+  ~21%% of device time in the 350m trace — and cost MORE memory
+  (15.6 vs 10.9 GiB at the same point). Serving keeps scan_layers (its
+  constraint is program size / launch latency, DECODE_r04.md).
+- Winner on one v5e lite chip: 760m preset (1.01B params), B=2,
+  flash(1024,1024), remat="dots", unrolled -> 50.4%% MFU, 14.9k tok/s.
 
-    python scripts/train_llm_mfu.py --sweep --json TRAIN_LLM_r05.json
-    python scripts/train_llm_mfu.py --preset 350m --remat --trace
-
-CPU smoke (tiny shapes, correctness of the harness only):
-
-    JAX_PLATFORMS=cpu python scripts/train_llm_mfu.py --preset smoke --steps 2
+Run:  python -m pytorch_distributed_training_tutorials_tpu.bench.lm_headline [--json out.json]
+Sweep CLI with the full tuning grid: scripts/train_llm_mfu.py.
 """
 
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import functools
 import json
 import os
 import sys
 import time
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PEAK_BF16 = 197e12  # TPU v5e lite chip peak, bf16
 
@@ -251,91 +239,44 @@ def measure(args) -> dict:
     return out
 
 
+
+
 def parse(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--preset", choices=sorted(PRESETS), default="350m")
+    p.add_argument("--preset", choices=sorted(PRESETS), default="760m")
     p.add_argument("--seq", type=int, default=2048)
-    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--batch", type=int, default=2)
     p.add_argument("--attn", choices=["dense", "flash"], default="flash")
-    p.add_argument("--block_q", type=int, default=512)
-    p.add_argument("--block_k", type=int, default=512)
-    p.add_argument("--remat", action="store_true")
-    p.add_argument("--no_scan", action="store_true",
-                   help="unroll the layer stack instead of nn.scan: "
-                   "longer compiles, but no scan-carry activation "
-                   "stacking (the dynamic-update-slice copies measured "
-                   "~21%% of device time in the scanned 350m step)")
-    p.add_argument("--remat_policy", choices=["dots", "dots_attn"], default=None,
-                   help="what remat may keep: None = recompute all, "
-                   "'dots' = save matmul outputs (checkpoint_dots_with_"
-                   "no_batch_dims_saveable)")
-    p.add_argument("--steps", type=int, default=8,
-                   help="steps per compiled lax.scan chain")
-    p.add_argument("--reps", type=int, default=3, help="min-of-N chain runs")
-    p.add_argument("--trace", action="store_true",
-                   help="capture a device trace of one chain run")
-    p.add_argument("--mem_only", action="store_true",
-                   help="compile and report XLA peak-memory estimate only")
-    p.add_argument("--sweep", action="store_true",
-                   help="run the round-5 tuning table instead of one point")
-    p.add_argument("--json", default=None, help="write results JSON here")
+    p.add_argument("--block_q", type=int, default=1024)
+    p.add_argument("--block_k", type=int, default=1024)
+    p.add_argument("--remat", action="store_true", default=True)
+    p.add_argument("--no_remat", dest="remat", action="store_false")
+    p.add_argument("--remat_policy", choices=["dots", "dots_attn"],
+                   default="dots")
+    p.add_argument("--no_scan", action="store_true", default=True,
+                   help="unrolled layers (the training winner; see module "
+                   "docstring)")
+    p.add_argument("--scan", dest="no_scan", action="store_false")
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--trace", action="store_true")
+    p.add_argument("--mem_only", action="store_true")
+    p.add_argument("--json", default=None)
     return p.parse_args(argv)
 
 
-# Memory-feasible grid (probed with --mem_only on the v5e's 15.75 GiB
-# HBM: 350m B=8 remat 10.8 GiB, B=16 remat 14.1 GiB; B=8 WITHOUT remat
-# needs 32.5 GiB — no-remat only fits at toy batch, so remat is not a
-# tuning choice at this scale, it is the enabler of real batch sizes).
-SWEEP = [
-    # (preset, seq, batch, attn, block_q, block_k, remat[, remat_policy])
-    # round B: remat_policy="dots" (save projection/FFN matmul outputs,
-    # recompute attention internals + elementwise) and block_k variants
-    ("350m", 2048, 8, "flash", 512, 1024, True, "dots"),
-    ("350m", 2048, 4, "flash", 512, 1024, True, "dots"),
-    ("350m", 2048, 8, "flash", 512, 2048, True, None),
-    ("350m", 2048, 8, "flash", 256, 1024, True, None),
-    ("125m", 2048, 32, "flash", 512, 1024, True, None),
-    ("125m", 2048, 16, "flash", 512, 1024, True, "dots"),
-    ("760m", 2048, 2, "flash", 512, 1024, True, None),
-    ("760m", 2048, 2, "flash", 512, 1024, True, "dots"),
-]
-
-
 def main() -> None:
-    args = parse()
     if os.environ.get("JAX_PLATFORMS"):
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
-    results = []
-    if args.sweep:
-        for point in SWEEP:
-            preset, seq, batch, attn, bq, bk, remat = point[:7]
-            a = argparse.Namespace(**vars(args))
-            a.preset, a.seq, a.batch, a.attn = preset, seq, batch, attn
-            a.block_q, a.block_k, a.remat = bq, bk, remat
-            a.remat_policy = point[7] if len(point) > 7 else None
-            try:
-                r = measure(a)
-            except Exception as e:  # OOM points are data, not crashes
-                r = {
-                    "preset": preset, "seq": seq, "batch": batch,
-                    "attn": attn, "remat": remat,
-                    "error": f"{type(e).__name__}: {str(e)[:200]}",
-                }
-            results.append(r)
-            print(json.dumps(r))
-    else:
-        r = measure(args)
-        results.append(r)
-        print(json.dumps(r, indent=2))
-
+    args = parse()
+    r = measure(args)
+    print(json.dumps(r))
     if args.json:
         with open(args.json, "w") as f:
-            json.dump(results, f, indent=2)
+            json.dump(r, f, indent=2)
             f.write("\n")
-        print(f"results -> {args.json}")
 
 
 if __name__ == "__main__":
