@@ -204,8 +204,9 @@ def main() -> None:
         # (same bf16 dtype semantics as the in-scan path); unroll=8
         # amortizes while-loop bookkeeping and halves the loop-boundary
         # state copies (round-4 trace: device 10.60 -> 10.23 ms/step; see
-        # PROFILE_r04.md). The real epoch scan measured NO reliable unroll
-        # win (its body gathers the batch), so only this cached leg uses it.
+        # PROFILE_r04.md). The fused-epoch leg ALSO unrolls x8 now — the
+        # round-5 re-measure showed the round-4 "no win on the real epoch
+        # scan" reading was tunnel weather (make_headline_setup).
         chain_len = 256
         chain = make_step_chain(setup, chain_len, unroll=8)
 

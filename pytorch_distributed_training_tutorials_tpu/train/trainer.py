@@ -279,6 +279,7 @@ def make_epoch_scan(
     aux_loss_weight: float = 0.0,
     transform=None,
     unroll: int = 1,
+    pregather: bool = False,
 ):
     """Build a jitted *whole-epoch* program: ``lax.scan`` of the train step
     over a device-resident dataset.
@@ -298,18 +299,40 @@ def make_epoch_scan(
     on the ResNet-18 bs512 leg — the loop-boundary ``copy-start/copy-done``
     pairs halved). Costs compile time roughly linearly; 1 (no unroll) keeps
     test-suite compiles fast.
+
+    ``pregather`` hoists the row gather OUT of the scan body: one epoch-wide
+    take reshapes the resident dataset to ``(steps, B, ...)`` and the scan
+    consumes contiguous leading-axis slices instead of doing a 512-row
+    gather per iteration, at the cost of a transient epoch-sized HBM copy
+    (uint8 MNIST x 5 fused epochs ~ 0.3 GB). Measured on the v5e headline
+    workload it is NEUTRAL TO SLIGHTLY WORSE (46.5k -> 45.7k img/s at
+    unroll=1; 48.0k -> 47.7k at unroll=8, min-of-3) — the in-body gather
+    fuses well there. Kept because the trade can flip for datasets whose
+    gather does not fuse (host-padded layouts, very wide rows); measure
+    before enabling. What DID move the headline is ``unroll=8`` on this
+    scan (BENCH_r05).
     """
     step_fn = _train_step_fn(loss, has_batch_stats, aux_loss_weight)
 
     def epoch_fn(state: TrainState, idx, data):
-        def body(state, idx_step):
-            batch = tuple(a[idx_step] for a in data)
+        def body(state, batch):
             if transform is not None:
                 batch = transform(*batch)
             state, metrics = step_fn(state, batch)
             return state, metrics["loss"]
 
-        state, losses = jax.lax.scan(body, state, idx, unroll=unroll)
+        if pregather:
+            stacked = tuple(a[idx] for a in data)  # (T, B, ...) one take
+            state, losses = jax.lax.scan(
+                body, state, stacked, unroll=unroll
+            )
+        else:
+            def gather_body(state, idx_step):
+                return body(state, tuple(a[idx_step] for a in data))
+
+            state, losses = jax.lax.scan(
+                gather_body, state, idx, unroll=unroll
+            )
         return state, losses
 
     return jax.jit(epoch_fn, donate_argnums=0)
@@ -394,6 +417,7 @@ class Trainer:
         log_every: int | None = None,
         defer_host_fetch: bool = False,
         scan_unroll: int = 1,
+        pregather: bool = False,
     ):
         self.model = model
         self.loader = train_loader
@@ -459,6 +483,10 @@ class Trainer:
         if scan_unroll < 1:
             raise ValueError(f"scan_unroll must be >= 1, got {scan_unroll}")
         self.scan_unroll = scan_unroll
+        # pregather: hoist the per-step row gather out of the compiled
+        # epoch scan (make_epoch_scan pregather) — a perf knob for
+        # device-resident datasets, costing a transient epoch-sized copy
+        self.pregather = pregather
         # defer_host_fetch: end chunked epochs with block_until_ready
         # (completion only) instead of a per-epoch loss fetch — standard
         # TPU practice to keep host-device syncs out of the training loop.
@@ -506,6 +534,7 @@ class Trainer:
                 aux_loss_weight=self.aux_loss_weight,
                 transform=loader.transform,
                 unroll=self.scan_unroll,
+                pregather=self.pregather,
             )
         log0(
             epoch_line(
@@ -543,6 +572,7 @@ class Trainer:
                 aux_loss_weight=self.aux_loss_weight,
                 transform=loader.transform,
                 unroll=self.scan_unroll,
+                pregather=self.pregather,
             )
         idx = jnp.concatenate(
             [

@@ -195,3 +195,29 @@ def test_raw_uint8_dataset_matches_f32(mesh):
         u8.arrays[0][:64].astype(np.float32) / 255.0, f32.arrays[0][:64]
     )
     np.testing.assert_array_equal(u8.arrays[1][:64], f32.arrays[1][:64])
+
+
+def test_pregather_epoch_matches_body_gather(mesh):
+    """Trainer(pregather=True) hoists the row gather out of the compiled
+    epoch scan (one epoch-wide take, scan over stacked xs) — a perf knob
+    that must be loss-for-loss and param-for-param identical to the
+    in-body gather."""
+    ds = synthetic_regression(256)
+
+    def make_trainer(pregather):
+        loader = DeviceResidentLoader(ds, 8, mesh, seed=0)
+        return Trainer(
+            LinearRegressor(), loader, optax.sgd(1e-2), loss="mse",
+            pregather=pregather,
+        )
+
+    t_a = make_trainer(False)
+    m_a = t_a.run_epochs_fused(0, 2)
+    t_b = make_trainer(True)
+    m_b = t_b.run_epochs_fused(0, 2)
+    np.testing.assert_allclose(m_b["loss"], m_a["loss"], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(t_b.state.params["Dense_0"]["kernel"]),
+        np.asarray(t_a.state.params["Dense_0"]["kernel"]),
+        rtol=1e-6,
+    )
