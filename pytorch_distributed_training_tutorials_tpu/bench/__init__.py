@@ -4,3 +4,7 @@ from pytorch_distributed_training_tutorials_tpu.bench.harness import (  # noqa: 
     benchmark,
     BenchResult,
 )
+
+# heavyweight legs stay import-lazy: bench.headline / bench.scaling /
+# bench.lm_headline are CLI modules (python -m ...) and import jax state
+# on use, not at package import (tests/test_import_purity.py)
