@@ -62,12 +62,16 @@ def _fwd_kernel(
 
     @pl.when(_causal_overlap(qi, kk, block_q, block_k))
     def _body():
-        q = q_ref[0].astype(jnp.float32)  # (BQ, D)
-        k = k_ref[0].astype(jnp.float32)  # (BK, D)
+        # matmul operands stay in the INPUT dtype: upcasting bf16->f32
+        # adds no information (products accumulate f32 either way via
+        # preferred_element_type), and Mosaic is what decides the MXU
+        # pass structure — measured identical on v5e with or without the
+        # explicit upcast (it folds the convert into the op), so the
+        # native form is kept for clarity, not speed
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # (BQ, BK)
+        ) * scale  # (BQ, BK) f32
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
         )
@@ -119,12 +123,9 @@ def _dq_kernel(
 
     @pl.when(_causal_overlap(qi, kk, block_q, block_k))
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype operands, f32 accumulation (see _fwd_kernel note)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -139,9 +140,9 @@ def _dq_kernel(
         # padded tail have lse == -inf -> guard like the forward
         p = jnp.exp(s - jnp.where(lse == NEG_INF, 0.0, lse))
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (BQ, BK)
+        )  # (BQ, BK) f32
         ds = p * (dp - delta_ref[0, 0, :][:, None])  # (BQ, BK)
         acc_ref[:] += jax.lax.dot(
             ds.astype(k_ref.dtype), k_ref[0],
@@ -169,12 +170,9 @@ def _dkv_kernel(
 
     @pl.when(_causal_overlap(qi, kk, block_q, block_k))
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype operands, f32 accumulation (see _fwd_kernel note)
         st = jax.lax.dot_general(
-            k, q, (((1,), (1,)), ((), ())),
+            k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (BK, BQ) — transposed scores
         k_pos = kk * block_k + jax.lax.broadcasted_iota(
@@ -187,12 +185,13 @@ def _dkv_kernel(
         lse = lse_ref[0, 0, :][None, :]  # (1, BQ)
         pt = jnp.exp(st - jnp.where(lse == NEG_INF, 0.0, lse))  # (BK, BQ)
         dv_acc[:] += jax.lax.dot(
-            pt.astype(do.dtype), do, preferred_element_type=jnp.float32
+            pt.astype(do_ref.dtype), do_ref[0],
+            preferred_element_type=jnp.float32,
         )
         dpt = jax.lax.dot_general(
-            v, do, (((1,), (1,)), ((), ())),
+            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (BK, BQ)
+        )  # (BK, BQ) f32
         dst = pt * (dpt - delta_ref[0, 0, :][None, :])
         dk_acc[:] += jax.lax.dot(
             dst.astype(q_ref.dtype), q_ref[0],
