@@ -85,7 +85,12 @@ def main() -> None:
     n_chips = mesh.devices.size
     per_device_batch = setup.per_device_batch
 
-    fused_epochs = 3
+    # 6 epochs per fused launch (was 3): the fused region pays ONE
+    # launch + fetch (~100-130 ms on this tunnel) regardless of length, so
+    # doubling the span halves the per-epoch share of it (round-5 A/B:
+    # +~1.5% end-to-end). Accuracy trains a few epochs longer; the target
+    # check is unaffected (MNIST plateaus >=0.996 well before epoch 10).
+    fused_epochs = 6
     with contextlib.redirect_stdout(sys.stderr):
         # TIMING DISCIPLINE on the tunneled runtime (measured, round 3):
         # before the process's first D2H fetch, `block_until_ready` and
@@ -228,10 +233,14 @@ def main() -> None:
         # accuracy"): evaluate on the held-out test split with wrap-padding
         # masked (unbiased). Target: 0.99 — conventional MNIST ResNet
         # accuracy. The surrogate is tuned so the target is FALSIFIABLE
-        # (data/datasets.py signal=0.35: healthy 7-epoch training measures
-        # 0.9961 with nonzero loss, signal=0.30 misses at 0.9867, and a
-        # broken config fails outright — tests/test_accuracy_falsifiable.py
-        # pins the negative control). `synthetic` says which data this was.
+        # (data/datasets.py signal=0.35: healthy training measures 0.9961
+        # with nonzero loss; the signal=0.30 negative control misses at
+        # 0.9867 after 7 epochs AND still at 0.9863 after the full
+        # 19-epoch span this bench now trains — re-measured round 5 when
+        # fused_epochs doubled, so longer training cannot sneak a degraded
+        # config past the target; a broken config fails outright —
+        # tests/test_accuracy_falsifiable.py pins that control).
+        # `synthetic` says which data this was.
         test_loader = DeviceResidentLoader(
             mnist("test", raw=True),
             per_device_batch,

@@ -29,8 +29,13 @@ Methodology (per CLAUDE.md's tunnel rules):
 
 Run on the real chip:
 
-    python scripts/train_llm_mfu.py --sweep --json TRAIN_LLM_r05.json
+    python scripts/train_llm_mfu.py --sweep --json sweep.json
     python scripts/train_llm_mfu.py --preset 350m --remat --trace
+
+(The committed TRAIN_LLM_r05.json receipt comes from the tuned-winner
+CLI, ``python -m pytorch_distributed_training_tutorials_tpu.bench.lm_headline`` — 12-step chain;
+this sweep harness defaults to 8-step chains, ~1.5 MFU points more
+launch-amortization per row, fine for RELATIVE comparisons.)
 
 CPU smoke (tiny shapes, correctness of the harness only):
 
