@@ -12,6 +12,7 @@ reference tutorial suite ``duoan/pytorch_distributed_training_tutorials``
 - auto placement / sharded checkpoint restore         -> :mod:`.parallel.auto`
 - models (MLP, ResNet-18/50) and utilities            -> :mod:`.models`
 - benchmark harness                                   -> :mod:`.bench`
+- static invariant enforcement (graftcheck)           -> :mod:`.analysis`
 
 Design stance (SURVEY.md section 7): the reference's three distinct parallelism
 APIs (nn.DataParallel, DistributedDataParallel, manual ``.to(device)`` splits)
@@ -19,20 +20,45 @@ collapse into one mesh + sharding abstraction with three configurations. The
 observable semantics of the reference are preserved: per-device batch-size flag
 meaning, steps-per-epoch math, epoch-seeded reshuffle, rank-0 logging, the
 2-stage split, and the benchmark comparison.
+
+The top-level conveniences are PEP 562 lazy re-exports: importing this
+package does not import jax. That keeps ``python -m
+pytorch_distributed_training_tutorials_tpu.analysis`` (graftcheck) jax-free end to end, and is
+one more layer of the import-purity hard rule — nothing can compute at
+import time if nothing jax-flavored is even imported.
 """
+
+import importlib
 
 __version__ = "0.1.0"
 
-from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (  # noqa: F401
-    create_mesh,
-    DATA_AXIS,
-    MODEL_AXIS,
-    STAGE_AXIS,
-    SEQ_AXIS,
-)
-from pytorch_distributed_training_tutorials_tpu.parallel.distributed import (  # noqa: F401
-    init,
-    shutdown,
-    process_index,
-    process_count,
-)
+# name -> (module, attribute); resolved on first access via __getattr__.
+_LAZY_EXPORTS = {
+    "create_mesh": ("pytorch_distributed_training_tutorials_tpu.parallel.mesh", "create_mesh"),
+    "DATA_AXIS": ("pytorch_distributed_training_tutorials_tpu.parallel.mesh", "DATA_AXIS"),
+    "MODEL_AXIS": ("pytorch_distributed_training_tutorials_tpu.parallel.mesh", "MODEL_AXIS"),
+    "STAGE_AXIS": ("pytorch_distributed_training_tutorials_tpu.parallel.mesh", "STAGE_AXIS"),
+    "SEQ_AXIS": ("pytorch_distributed_training_tutorials_tpu.parallel.mesh", "SEQ_AXIS"),
+    "init": ("pytorch_distributed_training_tutorials_tpu.parallel.distributed", "init"),
+    "shutdown": ("pytorch_distributed_training_tutorials_tpu.parallel.distributed", "shutdown"),
+    "process_index": ("pytorch_distributed_training_tutorials_tpu.parallel.distributed", "process_index"),
+    "process_count": ("pytorch_distributed_training_tutorials_tpu.parallel.distributed", "process_count"),
+}
+
+__all__ = ["__version__", *_LAZY_EXPORTS]
+
+
+def __getattr__(name: str):
+    try:
+        module_name, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(importlib.import_module(module_name), attr)
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))

@@ -1,0 +1,41 @@
+"""graftcheck: static enforcement of the repo's TPU-correctness invariants.
+
+Pure stdlib ``ast`` analysis — this package must NEVER import jax (or
+numpy, flax, ...): it has to run in milliseconds, run before any backend
+exists, and be structurally incapable of violating the import-purity rule
+it enforces. ``tests/test_static_analysis.py`` pins the no-jax property.
+
+The CLAUDE.md hard rules it machine-checks, by rule id:
+
+- ``import-purity``      — no jax computation at import time (module level,
+                           class attributes, default argument values)
+- ``traced-control-flow``— no Python control flow on traced args under
+                           jit/pjit/shard_map/remat (static_argnums honored)
+- ``strategy-interface`` — strategies in parallel/ implement the full
+                           variable_shardings/shard_state/shard_batch/
+                           num_devices contract
+- ``host-sync-hazard``   — no device_get/block_until_ready/np.asarray
+                           inside traced bodies
+- ``reference-citation`` — docstring file:line citations parse and resolve
+
+Suppress a finding inline, reason mandatory::
+
+    x = ...  # graftcheck: disable=<rule-id> -- why this is safe
+
+CLI: ``python -m pytorch_distributed_training_tutorials_tpu.analysis [paths]`` (or the
+``graftcheck`` console script); exits non-zero on unsuppressed findings.
+Library: :func:`analyze_paths` / :func:`analyze_file`.
+"""
+
+from pytorch_distributed_training_tutorials_tpu.analysis.engine import (  # noqa: F401
+    Config,
+    analyze_file,
+    analyze_paths,
+    iter_python_files,
+)
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding  # noqa: F401
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import (  # noqa: F401
+    Rule,
+    all_rules,
+    register,
+)

@@ -1,0 +1,6 @@
+"""``python -m pytorch_distributed_training_tutorials_tpu.analysis`` entry point."""
+
+from pytorch_distributed_training_tutorials_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

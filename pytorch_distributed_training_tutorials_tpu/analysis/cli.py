@@ -1,0 +1,129 @@
+"""graftcheck CLI: ``python -m pytorch_distributed_training_tutorials_tpu.analysis [paths]``.
+
+Exit codes: 0 = clean (every finding suppressed or none), 1 = unsuppressed
+findings, 2 = usage error. Text output is ``path:line:col: [rule] message``
+(editor-clickable); ``--json`` emits the full machine-readable report
+including suppressed findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from pytorch_distributed_training_tutorials_tpu.analysis import engine, registry
+
+# What the repo-wide sweep covers when no paths are given (the tier-1
+# contract: the whole package plus every entry-point script).
+DEFAULT_PATHS = (
+    "pytorch_distributed_training_tutorials_tpu",
+    "scripts",
+    "examples",
+)
+
+
+def _default_paths() -> list[str]:
+    found = [p for p in DEFAULT_PATHS if Path(p).exists()]
+    return found or ["."]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="graftcheck",
+        description=(
+            "AST-based enforcement of this repo's TPU-correctness "
+            "invariants (import purity, traced control flow, strategy "
+            "interface, host-sync hazards, reference citations). "
+            "Pure stdlib: never imports jax."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories (default: "
+             + ", ".join(DEFAULT_PATHS) + " where present)",
+    )
+    parser.add_argument(
+        "--select", metavar="RULES",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit a JSON report on stdout"
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="also print suppressed findings in text output",
+    )
+    parser.add_argument(
+        "--reference-root", metavar="DIR", default=None,
+        help="root the reference-citation rule resolves against "
+             "(default: /root/reference; skipped when absent)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="list rule ids and descriptions, then exit",
+    )
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(registry.all_rules().items()):
+            print(f"{rid}\n    {rule.description}")
+        for rid in sorted(registry.ENGINE_RULE_IDS):
+            print(f"{rid}\n    (engine diagnostic)")
+        return 0
+
+    config = engine.Config()
+    if args.reference_root:
+        config.reference_root = Path(args.reference_root)
+
+    try:
+        rules = list(registry.select_rules(
+            [r.strip() for r in args.select.split(",") if r.strip()]
+            if args.select else None
+        ))
+    except KeyError as exc:
+        print(f"graftcheck: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or _default_paths()
+    t0 = time.perf_counter()
+    try:
+        findings, n_files = engine.analyze_paths(paths, rules, config)
+    except FileNotFoundError as exc:
+        print(f"graftcheck: {exc}", file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - t0
+
+    unsuppressed = [f for f in findings if not f.suppressed]
+    suppressed = [f for f in findings if f.suppressed]
+
+    if args.json:
+        print(json.dumps({
+            "files": n_files,
+            "elapsed_s": round(elapsed, 3),
+            "rules": [r.id for r in rules],
+            "unsuppressed": len(unsuppressed),
+            "suppressed": len(suppressed),
+            "findings": [f.to_dict() for f in findings],
+        }, indent=2))
+    else:
+        shown = findings if args.show_suppressed else unsuppressed
+        for f in shown:
+            print(f.render())
+        print(
+            f"graftcheck: {n_files} files, "
+            f"{len(unsuppressed)} finding(s) "
+            f"({len(suppressed)} suppressed) in {elapsed:.2f}s"
+        )
+    return 1 if unsuppressed else 0
+
+
+def console_main() -> None:  # the pyproject [project.scripts] hook
+    raise SystemExit(main())

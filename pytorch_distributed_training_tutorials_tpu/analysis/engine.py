@@ -1,0 +1,183 @@
+"""graftcheck engine: parse each file once, run every rule, apply
+suppressions.
+
+The engine owns everything rules share — the parsed tree, the import map,
+the traced-context index — as lazy cached properties on
+:class:`FileContext`, so adding a rule never re-parses or re-walks. It also
+owns the two pseudo-rules no Rule class can express: ``parse-error`` (the
+file did not parse; nothing else can be checked) and ``bad-suppression``
+(a suppression comment with no reason or an unknown rule id).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from functools import cached_property
+from pathlib import Path
+from typing import Iterable, Sequence
+
+from pytorch_distributed_training_tutorials_tpu.analysis import registry, suppressions
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding, sort_key
+from pytorch_distributed_training_tutorials_tpu.analysis.jitscope import JitContext, discover
+from pytorch_distributed_training_tutorials_tpu.analysis.names import ImportMap
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+@dataclass
+class Config:
+    """Knobs the CLI exposes; rules read what they need."""
+
+    # Where `file:line` docstring citations resolve (CLAUDE.md hard rule 5).
+    # Checked only when the tree actually exists on this machine.
+    reference_root: Path = Path("/root/reference")
+    # Repo root for repo-internal citations; autodetected per file when None.
+    repo_root: Path | None = None
+
+
+@dataclass
+class FileContext:
+    """One parsed file + lazily-built shared indexes."""
+
+    path: Path
+    source: str
+    tree: ast.AST
+    config: Config = field(default_factory=Config)
+
+    @cached_property
+    def import_map(self) -> ImportMap:
+        return ImportMap(self.tree)
+
+    @cached_property
+    def jit_contexts(self) -> list[JitContext]:
+        return discover(self.tree, self.import_map)
+
+    @cached_property
+    def repo_root(self) -> Path | None:
+        if self.config.repo_root is not None:
+            return self.config.repo_root
+        for parent in self.path.resolve().parents:
+            if (parent / "pyproject.toml").exists():
+                return parent
+        return None
+
+
+def iter_python_files(paths: Iterable[str | Path]) -> list[Path]:
+    """Expand files/dirs into a sorted, deduplicated .py file list."""
+    seen: dict[Path, None] = {}
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                if not any(part in _SKIP_DIRS for part in sub.parts):
+                    seen.setdefault(sub, None)
+        elif p.suffix == ".py":
+            seen.setdefault(p, None)
+        elif not p.exists():
+            raise FileNotFoundError(f"no such path: {p}")
+    return list(seen)
+
+
+def analyze_file(
+    path: str | Path,
+    rules: Sequence[registry.Rule] | None = None,
+    config: Config | None = None,
+    source: str | None = None,
+) -> list[Finding]:
+    """All findings for one file, suppression state applied."""
+    path = Path(path)
+    config = config or Config()
+    if rules is None:
+        rules = list(registry.all_rules().values())
+    if source is None:
+        source = path.read_text(encoding="utf-8")
+
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        return [Finding(
+            rule=registry.PARSE_ERROR,
+            path=str(path),
+            line=exc.lineno or 1,
+            col=(exc.offset or 1) - 1,
+            message=f"file does not parse: {exc.msg}",
+        )]
+    except ValueError as exc:  # e.g. null bytes in source
+        return [Finding(
+            rule=registry.PARSE_ERROR,
+            path=str(path),
+            line=1,
+            col=0,
+            message=f"file does not parse: {exc}",
+        )]
+
+    ctx = FileContext(path=path, source=source, tree=tree, config=config)
+    findings: list[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+
+    # Nested traced contexts can surface one hazard through two walks;
+    # report each location once.
+    deduped: dict[tuple, Finding] = {}
+    for f in findings:
+        deduped.setdefault((f.rule, f.line, f.col, f.message), f)
+    findings = list(deduped.values())
+
+    sups = suppressions.collect(source)
+    known = registry.known_rule_ids()
+    for sup in sups:
+        unknown = sup.rules - known
+        if unknown:
+            findings.append(Finding(
+                rule=registry.BAD_SUPPRESSION,
+                path=str(path),
+                line=sup.comment_line,
+                col=0,
+                message=(
+                    "suppression names unknown rule(s): "
+                    + ", ".join(sorted(unknown))
+                ),
+            ))
+        if not sup.reason:
+            findings.append(Finding(
+                rule=registry.BAD_SUPPRESSION,
+                path=str(path),
+                line=sup.comment_line,
+                col=0,
+                message=(
+                    "suppression has no reason; write "
+                    "`# graftcheck: disable=<rule> -- <why this is safe>`"
+                ),
+            ))
+
+    by_line: dict[int, list[suppressions.Suppression]] = {}
+    for sup in sups:
+        if sup.reason:  # reasonless suppressions suppress nothing
+            by_line.setdefault(sup.target_line, []).append(sup)
+    for f in findings:
+        if f.rule == registry.BAD_SUPPRESSION:
+            continue
+        for sup in by_line.get(f.line, ()):
+            if f.rule in sup.rules:
+                f.suppressed = True
+                f.suppress_reason = sup.reason
+                break
+
+    findings.sort(key=sort_key)
+    return findings
+
+
+def analyze_paths(
+    paths: Iterable[str | Path],
+    rules: Sequence[registry.Rule] | None = None,
+    config: Config | None = None,
+) -> tuple[list[Finding], int]:
+    """(findings across all files, number of files checked)."""
+    files = iter_python_files(paths)
+    if rules is None:
+        rules = list(registry.all_rules().values())
+    findings: list[Finding] = []
+    for f in files:
+        findings.extend(analyze_file(f, rules=rules, config=config))
+    return findings, len(files)

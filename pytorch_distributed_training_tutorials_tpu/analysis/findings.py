@@ -1,0 +1,51 @@
+"""The one result type every graftcheck rule produces.
+
+A :class:`Finding` is a (rule, location, message) triple plus suppression
+state. Suppression is decided by the engine (``engine.py``) after rules run,
+from ``# graftcheck: disable=<rule> -- <reason>`` comments, so rules never
+need to know about comments at all.
+
+Pure stdlib — this module (like the whole ``analysis`` package) must never
+import jax: the CLI has to run in milliseconds and must be incapable of
+violating the import-purity invariant it enforces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+
+@dataclass
+class Finding:
+    """One diagnostic at a source location.
+
+    ``line``/``col`` are 1-based line and 0-based column, matching both
+    ``ast`` node coordinates and the ``path:line:col`` convention editors
+    parse. ``suppressed`` findings are kept (for ``--show-suppressed`` and
+    the JSON report) but never affect the exit code.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str | None = None
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.location}: [{self.rule}] {self.message}{tag}"
+
+
+def sort_key(f: Finding):
+    """Stable report order: by file, then position, then rule id."""
+    return (f.path, f.line, f.col, f.rule)

@@ -1,0 +1,258 @@
+"""Find traced code: which functions in a file compile under jit, and which
+of their parameters are tracers.
+
+"Traced code" is where the CLAUDE.md jit hard rules bite: Python control
+flow on a traced value raises ``TracerBoolConversionError`` (or silently
+bakes in one branch), and host syncs stall the pipeline. A function counts
+as traced when it is
+
+- decorated with ``jax.jit`` / ``pjit`` / ``jax.checkpoint`` / ``nn.remat``
+  (bare, called, or via ``functools.partial(jax.jit, ...)``), or
+- passed by name (or as ``self.method`` / a class whose ``__call__`` is
+  then traced, the ``nn.remat(Block, static_argnums=(2, 3))`` idiom of
+  models/transformer.py:580) to one of those wrappers or to ``shard_map``
+  anywhere in the same file, or
+- defined *inside* such a function (``lax.scan`` bodies, microbatch
+  closures): those run at trace time with tracer arguments.
+
+``static_argnums`` / ``static_argnames`` are honored when they are literal
+ints/strings; a non-literal static spec makes the context ``unknown_statics``
+and strict per-argument rules skip it rather than guess. Argnum indices
+count the full positional list *including* ``self`` (jax's convention — see
+the transformer's "args 2/3 of __call__ incl. self" comment), and
+``self``/``cls`` are never treated as traced.
+
+Known limitation (kept deliberately — zero false positives beats recall
+here): a function only *called from* traced code but never wrapped or
+nested in it is not discovered, and rebinding a wrapped class through a
+local variable (``cell = nn.remat(cell, ...)``) is not chased.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from pytorch_distributed_training_tutorials_tpu.analysis.names import ImportMap
+
+FuncNode = ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+
+# Dotted paths that compile/trace their function argument.
+JIT_WRAPPERS = frozenset({
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.remat",
+    "jax.checkpoint",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "flax.linen.jit",
+    "flax.linen.remat",
+    "flax.linen.checkpoint",
+})
+
+_PARTIAL = frozenset({"functools.partial"})
+
+
+@dataclass
+class JitContext:
+    """One function whose body is traced, plus which params are tracers."""
+
+    func: FuncNode
+    wrapper: str                 # dotted wrapper path, or "<nested>"
+    traced: frozenset[str] = frozenset()
+    unknown_statics: bool = False
+    nested: bool = False         # syntactically inside another context
+
+    @property
+    def name(self) -> str:
+        return getattr(self.func, "name", "<lambda>")
+
+
+def _extract_statics(call: ast.Call) -> tuple[set[int], set[str], bool]:
+    """Literal static_argnums/static_argnames from a wrapper call; any
+    non-literal spec (or **kwargs) -> unknown."""
+    nums: set[int] = set()
+    names: set[str] = set()
+    unknown = False
+
+    def ints(node) -> list[int] | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for elt in node.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    return None
+                out.append(elt.value)
+            return out
+        return None
+
+    def strs(node) -> list[str] | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for elt in node.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)):
+                    return None
+                out.append(elt.value)
+            return out
+        return None
+
+    for kw in call.keywords:
+        if kw.arg is None:  # **opts could smuggle a static spec
+            unknown = True
+        elif kw.arg == "static_argnums":
+            got = ints(kw.value)
+            if got is None:
+                unknown = True
+            else:
+                nums.update(got)
+        elif kw.arg == "static_argnames":
+            got = strs(kw.value)
+            if got is None:
+                unknown = True
+            else:
+                names.update(got)
+    return nums, names, unknown
+
+
+def _wrapper_info(node: ast.AST, imap: ImportMap):
+    """(wrapper_path, argnums, argnames, unknown) if ``node`` is a jit
+    wrapper expression (decorator or call head), else None."""
+    path = imap.resolve(node)
+    if path in JIT_WRAPPERS:
+        return path, set(), set(), False
+    if isinstance(node, ast.Call):
+        fpath = imap.resolve(node.func)
+        if fpath in JIT_WRAPPERS:
+            nums, names, unknown = _extract_statics(node)
+            return fpath, nums, names, unknown
+        if fpath in _PARTIAL and node.args:
+            inner = imap.resolve(node.args[0])
+            if inner in JIT_WRAPPERS:
+                nums, names, unknown = _extract_statics(node)
+                return inner, nums, names, unknown
+    return None
+
+
+def _traced_params(func: FuncNode, nums: set[int], names: set[str]
+                   ) -> frozenset[str]:
+    a = func.args
+    positional = [x.arg for x in (a.posonlyargs + a.args)]
+    traced: set[str] = set()
+    for i, nm in enumerate(positional):
+        if i in nums or nm in names:
+            continue
+        traced.add(nm)
+    for x in a.kwonlyargs:
+        if x.arg not in names:
+            traced.add(x.arg)
+    if a.vararg:
+        traced.add(a.vararg.arg)
+    if a.kwarg:
+        traced.add(a.kwarg.arg)
+    traced -= {"self", "cls"}
+    return frozenset(traced)
+
+
+class _SiteVisitor(ast.NodeVisitor):
+    """Collect wrap sites, tracking the enclosing class for ``self.X`` and
+    plain-name method targets."""
+
+    def __init__(self, imap: ImportMap):
+        self.imap = imap
+        self.class_stack: list[str] = []
+        # flat name indexes (last definition wins; fine at file scale)
+        self.defs: dict[str, FuncNode] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.methods: dict[tuple[str, str], FuncNode] = {}
+        # (func_node, wrapper, nums, names, unknown)
+        self.sites: list[tuple] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.classes[node.name] = node
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[(node.name, stmt.name)] = stmt
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node):
+        self.defs[node.name] = node
+        for dec in node.decorator_list:
+            info = _wrapper_info(dec, self.imap)
+            if info:
+                self.sites.append((node, *info))
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def _resolve_target(self, arg: ast.AST) -> FuncNode | None:
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            if arg.id in self.defs:
+                return self.defs[arg.id]
+            if arg.id in self.classes:  # nn.remat(Block, ...): traces __call__
+                return self.methods.get((arg.id, "__call__"))
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self" and self.class_stack):
+            return self.methods.get((self.class_stack[-1], arg.attr))
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        info = _wrapper_info(node, self.imap) if node.args else None
+        if info is not None and node.args:
+            target = self._resolve_target(node.args[0])
+            if target is not None:
+                self.sites.append((target, *info))
+        self.generic_visit(node)
+
+
+def discover(tree: ast.AST, imap: ImportMap) -> list[JitContext]:
+    """All traced contexts in a parsed module, nested bodies included."""
+    visitor = _SiteVisitor(imap)
+    visitor.visit(tree)
+
+    contexts: dict[int, JitContext] = {}
+    for func, wrapper, nums, names, unknown in visitor.sites:
+        prev = contexts.get(id(func))
+        ctx = JitContext(
+            func=func,
+            wrapper=wrapper,
+            traced=_traced_params(func, nums, names),
+            unknown_statics=unknown,
+        )
+        if prev is not None:
+            # Same function wrapped twice (e.g. decorator + call site):
+            # intersect traced sets, OR the uncertainty.
+            ctx.traced = prev.traced & ctx.traced
+            ctx.unknown_statics = prev.unknown_statics or ctx.unknown_statics
+        contexts[id(func)] = ctx
+
+    # Inner defs/lambdas of a traced body run at trace time with tracer
+    # args (scan bodies, grad closures): add them, all params traced.
+    for top in list(contexts.values()):
+        for node in ast.walk(top.func):
+            if node is top.func or not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            inner = contexts.get(id(node))
+            if inner is None:
+                contexts[id(node)] = JitContext(
+                    func=node,
+                    wrapper="<nested>",
+                    traced=_traced_params(node, set(), set()),
+                    nested=True,
+                )
+            else:
+                inner.nested = True
+    return list(contexts.values())

@@ -1,0 +1,73 @@
+"""Import-alias tracking: resolve ``jnp.zeros`` -> ``jax.numpy.zeros``.
+
+Rules reason about *dotted module paths* (``jax.numpy.asarray``,
+``numpy.asarray``) rather than surface spellings, so ``import jax.numpy as
+jnp``, ``from jax import numpy as jnp`` and ``from jax.numpy import zeros``
+all resolve identically. Only names that were actually imported resolve —
+a local variable that happens to be called ``jit`` resolves to ``None`` —
+which keeps every rule silent on files that never import the module family
+it polices.
+"""
+
+from __future__ import annotations
+
+import ast
+
+
+class ImportMap:
+    """Local name -> dotted path, built from every import in a module."""
+
+    def __init__(self, tree: ast.AST):
+        # name -> dotted path ("jnp" -> "jax.numpy"); built from imports at
+        # any nesting depth (function-local `import jax` still counts).
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.aliases[alias.asname] = alias.name
+                    else:
+                        # `import jax.numpy` binds the ROOT name `jax`
+                        root = alias.name.split(".")[0]
+                        self.aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                if node.level or not node.module:
+                    continue  # relative imports cannot reach jax/numpy
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.aliases[local] = f"{node.module}.{alias.name}"
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Dotted path for a Name/Attribute chain, or None if the root name
+        was never imported (plain locals never resolve)."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id)
+        if base is None:
+            return None
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    def resolves_under(self, node: ast.AST, roots: tuple[str, ...]) -> str | None:
+        """The resolved path if it sits under any of ``roots``, else None."""
+        path = self.resolve(node)
+        if path is None:
+            return None
+        for root in roots:
+            if path == root or path.startswith(root + "."):
+                return path
+        return None
+
+
+def path_matches(path: str, patterns) -> bool:
+    """True if ``path`` equals a pattern or sits under a pattern prefix."""
+    for pat in patterns:
+        if path == pat or path.startswith(pat + "."):
+            return True
+    return False

@@ -1,0 +1,85 @@
+"""Rule base class + registry.
+
+A rule is a stateless singleton with a stable kebab-case ``id`` (the name
+used in ``# graftcheck: disable=<id>`` suppressions and ``--select``) and a
+``check(ctx)`` generator over :class:`~.findings.Finding`. Registration is a
+class decorator so adding a rule is: write a module under ``rules/``, import
+it from ``rules/__init__.py``, done — no central dispatch table to edit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+
+# rule id -> singleton instance, in registration order
+_RULES: dict[str, "Rule"] = {}
+
+# Engine-emitted pseudo-rule ids (no Rule class behind them). They are valid
+# targets for `disable=` so e.g. a deliberately unparseable fixture can be
+# checked in, and so suppression-comment validation knows the full id set.
+PARSE_ERROR = "parse-error"
+BAD_SUPPRESSION = "bad-suppression"
+ENGINE_RULE_IDS = frozenset({PARSE_ERROR, BAD_SUPPRESSION})
+
+
+class Rule:
+    """Base class for graftcheck rules."""
+
+    id: str = ""
+    description: str = ""
+
+    def check(self, ctx) -> Iterable[Finding]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def finding(self, ctx, node: ast.AST | None, message: str,
+                line: int | None = None, col: int | None = None) -> Finding:
+        """Build a Finding for ``node`` (or an explicit line/col) in ``ctx``."""
+        if node is not None:
+            line = getattr(node, "lineno", line or 1)
+            col = getattr(node, "col_offset", col or 0)
+        return Finding(
+            rule=self.id,
+            path=str(ctx.path),
+            line=line or 1,
+            col=col or 0,
+            message=message,
+        )
+
+
+def register(cls: type) -> type:
+    """Class decorator: instantiate and register a Rule by its id."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in _RULES or inst.id in ENGINE_RULE_IDS:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    _RULES[inst.id] = inst
+    return cls
+
+
+def all_rules() -> dict[str, Rule]:
+    """All registered rules (importing the rules package to populate)."""
+    # Deferred import: rules modules use @register from here.
+    import pytorch_distributed_training_tutorials_tpu.analysis.rules  # noqa: F401
+
+    return dict(_RULES)
+
+
+def known_rule_ids() -> frozenset[str]:
+    return frozenset(all_rules()) | ENGINE_RULE_IDS
+
+
+def select_rules(select: Iterable[str] | None) -> Iterator[Rule]:
+    rules = all_rules()
+    if select is None:
+        yield from rules.values()
+        return
+    for rid in select:
+        if rid not in rules:
+            raise KeyError(
+                f"unknown rule {rid!r}; known: {', '.join(sorted(rules))}"
+            )
+        yield rules[rid]

@@ -1,0 +1,14 @@
+"""graftcheck rules. Importing this package registers every rule.
+
+Each module holds one rule; the registry (``..registry``) is populated by
+the ``@register`` decorators at import. To add a rule: new module here,
+import it below, and the engine/CLI/`--list-rules` pick it up.
+"""
+
+from pytorch_distributed_training_tutorials_tpu.analysis.rules import (  # noqa: F401
+    host_sync,
+    import_purity,
+    reference_citation,
+    strategy_interface,
+    traced_control_flow,
+)

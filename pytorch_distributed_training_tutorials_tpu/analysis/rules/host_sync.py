@@ -1,0 +1,69 @@
+"""host-sync-hazard: no host round-trips inside traced code.
+
+``jax.device_get`` / ``.block_until_ready()`` / ``np.asarray`` inside a
+jit-traced body either fail on tracers outright or — worse — silently
+concretize during tracing and bake a constant into the compiled program.
+Either way they contradict the async-dispatch model the bench harness is
+built around (bench/harness.py: a timed region must *end* with exactly one
+deliberate fetch, never contain hidden ones).
+
+Outside traced code these calls are legitimate and common (every timing
+leg ends with ``block_until_ready``); this rule only looks inside the
+traced contexts found by :mod:`..jitscope`. ``jnp.asarray`` is always fine
+(it is a traced op, not a host sync).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import Rule, register
+
+# Dotted call paths that force a device->host transfer or a blocking wait.
+SYNC_PATHS = frozenset({
+    "jax.device_get",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.copyto",
+})
+
+
+@register
+class HostSyncHazard(Rule):
+    id = "host-sync-hazard"
+    description = (
+        "no jax.device_get / .block_until_ready() / np.asarray inside "
+        "jit-traced bodies — host syncs belong at timed-region boundaries"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        imap = ctx.import_map
+        # Walk only top contexts in full (nested defs included): nested
+        # contexts are syntactically inside them, and this check does not
+        # depend on which parameters are traced.
+        for jc in ctx.jit_contexts:
+            if jc.nested:
+                continue
+            for node in ast.walk(jc.func):
+                if not isinstance(node, ast.Call):
+                    continue
+                path = imap.resolve(node.func)
+                if path in SYNC_PATHS:
+                    yield self.finding(
+                        ctx, node,
+                        f"{path} inside traced code ({jc.name}); it "
+                        "concretizes/blocks during tracing — fetch outside "
+                        "the compiled function",
+                    )
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "block_until_ready"
+                        and path is None):
+                    yield self.finding(
+                        ctx, node,
+                        f".block_until_ready() inside traced code "
+                        f"({jc.name}); a traced value has nothing to wait "
+                        "for — sync outside the compiled function",
+                    )

@@ -1,0 +1,188 @@
+"""import-purity: no jax computation at module import time.
+
+The CLAUDE.md hard rule this enforces: any module-level jax computation —
+``jnp.float32(-inf)``, ``jnp.zeros(...)``, ``jax.devices()`` — initializes
+the XLA backend on import and breaks every multi-process world
+("jax.distributed.initialize() must be called before any JAX calls"; the
+round-2 ring-attention NEG_INF incident). The runtime guard
+(tests/test_import_purity.py) only sees what actually *executes* during one
+import; this rule statically covers everything that executes at import
+time for any importer:
+
+- module-level statements (descending through module-level ``if``/``try``/
+  ``with``/``for`` bodies, but NOT the ``if __name__ == "__main__":`` block
+  — scripts may compute there, that is what entry points are for),
+- class bodies (class attributes evaluate at import),
+- decorators and DEFAULT ARGUMENT VALUES of functions defined in those
+  scopes (defaults evaluate at ``def`` time — the case the runtime guard
+  structurally cannot catch until the function is imported *and* the
+  module graph reaches it),
+
+while never descending into function/lambda bodies (those run at call
+time, where jax computation is the whole point).
+
+Transform *constructors* are exempt: ``jax.jit(f)``, ``jax.tree_util``
+registrations, ``jax.config.update``, ``PartitionSpec()``,
+``jax.nn.initializers.normal(0.02)`` etc. build Python objects without
+touching the backend, and module-level jitting/registration is idiomatic.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+from pytorch_distributed_training_tutorials_tpu.analysis.names import path_matches
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import Rule, register
+
+# Dotted paths (exact or prefix) that are safe to CALL at import time:
+# they construct transforms/metadata without creating arrays or touching
+# the backend.
+SAFE_CALLS = (
+    "jax.jit",
+    "jax.pjit",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.jacfwd",
+    "jax.jacrev",
+    "jax.hessian",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.custom_vjp",
+    "jax.custom_jvp",
+    "jax.custom_gradient",
+    "jax.custom_batching",
+    "jax.named_call",
+    "jax.named_scope",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pjit.pjit",
+    "jax.tree_util",
+    "jax.util",
+    "jax.config",
+    "jax.typing",
+    "jax.debug",
+    "jax.ShapeDtypeStruct",
+    "jax.sharding.PartitionSpec",
+    "jax.nn.initializers",
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_main_guard(node: ast.If) -> bool:
+    t = node.test
+    if not (isinstance(t, ast.Compare) and len(t.ops) == 1
+            and isinstance(t.ops[0], ast.Eq)):
+        return False
+    sides = [t.left, t.comparators[0]]
+    has_name = any(
+        isinstance(s, ast.Name) and s.id == "__name__" for s in sides
+    )
+    has_main = any(
+        isinstance(s, ast.Constant) and s.value == "__main__" for s in sides
+    )
+    return has_name and has_main
+
+
+def _iter_import_time_exprs(body) -> Iterator[tuple[ast.AST, str]]:
+    """(expr, kind) pairs whose evaluation happens at import time.
+
+    kind is "module" | "class" | "default" | "decorator", used only to
+    sharpen the message.
+    """
+    for node in body:
+        if isinstance(node, _FUNC_NODES):
+            for dec in node.decorator_list:
+                yield dec, "decorator"
+            for d in node.args.defaults:
+                yield d, "default"
+            for d in node.args.kw_defaults:
+                if d is not None:
+                    yield d, "default"
+            # body runs at call time: do not descend
+        elif isinstance(node, ast.ClassDef):
+            for dec in node.decorator_list:
+                yield dec, "decorator"
+            for b in (*node.bases, *(kw.value for kw in node.keywords)):
+                yield b, "class"
+            for sub, kind in _iter_import_time_exprs(node.body):
+                # defaults/decorators of methods keep their kind; plain
+                # class-body statements become class attributes
+                yield sub, ("class" if kind == "module" else kind)
+        elif isinstance(node, ast.If):
+            if _is_main_guard(node):
+                # the entry-point block: runs only as a script, after the
+                # process is free to (and must) initialize jax
+                yield from _iter_import_time_exprs(node.orelse)
+            else:
+                yield from _iter_import_time_exprs(node.body)
+                yield from _iter_import_time_exprs(node.orelse)
+        elif isinstance(node, ast.Try):
+            yield from _iter_import_time_exprs(node.body)
+            for h in node.handlers:
+                yield from _iter_import_time_exprs(h.body)
+            yield from _iter_import_time_exprs(node.orelse)
+            yield from _iter_import_time_exprs(node.finalbody)
+        elif isinstance(node, (ast.For, ast.While, ast.With)):
+            if isinstance(node, ast.For):
+                yield node.iter, "module"
+            elif isinstance(node, ast.While):
+                yield node.test, "module"
+            else:
+                for item in node.items:
+                    yield item.context_expr, "module"
+            yield from _iter_import_time_exprs(node.body)
+            yield from _iter_import_time_exprs(getattr(node, "orelse", []))
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        else:
+            yield node, "module"
+
+
+def _iter_calls(expr: ast.AST) -> Iterator[ast.Call]:
+    """Call nodes evaluated when ``expr`` is — skipping lambda/def bodies,
+    whose calls happen later."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Lambda, *_FUNC_NODES)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+_KIND_MSG = {
+    "module": "module-level",
+    "class": "class-attribute",
+    "default": "default-argument",
+    "decorator": "decorator",
+}
+
+
+@register
+class ImportPurity(Rule):
+    id = "import-purity"
+    description = (
+        "no jax/jnp computation at import time (module level, class "
+        "attributes, default argument values) — it initializes the XLA "
+        "backend and breaks jax.distributed.initialize()"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        imap = ctx.import_map
+        for expr, kind in _iter_import_time_exprs(ctx.tree.body):
+            for call in _iter_calls(expr):
+                path = imap.resolves_under(call.func, ("jax",))
+                if path is None or path_matches(path, SAFE_CALLS):
+                    continue
+                yield self.finding(
+                    ctx, call,
+                    f"{_KIND_MSG[kind]} call of {path} executes at import "
+                    "time and may initialize the XLA backend; move it "
+                    "inside a function (hard rule: import purity)",
+                )

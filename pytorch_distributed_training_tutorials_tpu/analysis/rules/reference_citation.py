@@ -1,0 +1,133 @@
+"""reference-citation: docstring ``file:line`` citations are well-formed
+and resolve.
+
+The CLAUDE.md hard rule: parity-visible code cites the reference behavior
+it reproduces as ``file:line`` into ``/root/reference/``. A citation that
+does not parse, or points past the end of the cited file, is documentation
+rot — the next refactor can no longer verify the parity claim.
+
+Checked in every docstring (module, class, function):
+
+- a ``<path>.py:`` / ``<path>.ipynb:`` token followed by something other
+  than a 1-based line number is malformed (pytest node ids, which use a
+  double colon, are exempt);
+- when the cited file can be found — repo-internal citations resolve
+  against the repo root, reference citations against the reference tree
+  (``Config.reference_root``, default ``/root/reference``) — the line must
+  exist in it. Resolution is attempted only where the relevant root is
+  actually present, so the rule degrades to pure well-formedness checking
+  on machines without the reference checkout.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import Rule, register
+
+# `path.py:123` (ranges `:12-14` cite their first line)
+CITATION = re.compile(
+    r"(?P<path>[A-Za-z0-9_\-./]*[A-Za-z0-9_\-]\.(?:py|ipynb)):(?P<line>\d+)"
+)
+# `path.py:` followed by a non-digit, non-space: a citation whose line part
+# is not a line number. A trailing space is prose, and a second colon is a
+# pytest node id (`test_x.py::test_y`), not a citation.
+MALFORMED = re.compile(r"[A-Za-z0-9_\-]\.(?:py|ipynb):(?=[^\d\s:])")
+
+_line_count_cache: dict[Path, int] = {}
+
+
+def _line_count(path: Path) -> int:
+    if path not in _line_count_cache:
+        try:
+            text = path.read_text(encoding="utf-8", errors="replace")
+        except OSError:
+            _line_count_cache[path] = -1
+        else:
+            _line_count_cache[path] = len(text.splitlines())
+    return _line_count_cache[path]
+
+
+def _iter_docstrings(tree: ast.AST) -> Iterator[ast.Constant]:
+    """Docstring Constant nodes (module/class/function) with positions."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (
+            ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef,
+        )):
+            continue
+        body = node.body
+        if (body and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)):
+            yield body[0].value
+
+
+@register
+class ReferenceCitation(Rule):
+    id = "reference-citation"
+    description = (
+        "docstring file:line citations parse and (when the cited tree is "
+        "present) point at an existing line"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        ref_root: Path = ctx.config.reference_root
+        ref_present = ref_root.is_dir()
+        repo_root = ctx.repo_root
+        for doc in _iter_docstrings(ctx.tree):
+            text = doc.value
+            for m in MALFORMED.finditer(text):
+                yield self._at(ctx, doc, text, m.start(),
+                               "malformed file:line citation (line part is "
+                               "not a number)")
+            for m in CITATION.finditer(text):
+                cited, line = m.group("path"), int(m.group("line"))
+                if line == 0:
+                    yield self._at(ctx, doc, text, m.start(),
+                                   f"citation {m.group(0)} cites line 0 "
+                                   "(lines are 1-based)")
+                    continue
+                target = self._resolve(cited, repo_root, ref_root,
+                                       ref_present)
+                if target is None:
+                    if ref_present:
+                        yield self._at(
+                            ctx, doc, text, m.start(),
+                            f"citation {m.group(0)}: file not found in the "
+                            f"reference tree ({ref_root}) or the repo",
+                        )
+                    continue
+                n = _line_count(target)
+                if 0 <= n < line:
+                    yield self._at(
+                        ctx, doc, text, m.start(),
+                        f"citation {m.group(0)} is past the end of "
+                        f"{target} ({n} lines)",
+                    )
+
+    def _resolve(self, cited: str, repo_root: Path | None, ref_root: Path,
+                 ref_present: bool) -> Path | None:
+        p = Path(cited)
+        if p.is_absolute():
+            if p.is_file():
+                return p
+            return None
+        if repo_root is not None and (repo_root / p).is_file():
+            return repo_root / p
+        if ref_present:
+            if (ref_root / p).is_file():
+                return ref_root / p
+            hits = sorted(ref_root.rglob(p.name))
+            if hits:
+                return hits[0]
+        return None
+
+    def _at(self, ctx, doc: ast.Constant, text: str, offset: int,
+            message: str) -> Finding:
+        # map a character offset inside the docstring onto a source line
+        line = doc.lineno + text.count("\n", 0, offset)
+        return self.finding(ctx, None, message, line=line, col=0)

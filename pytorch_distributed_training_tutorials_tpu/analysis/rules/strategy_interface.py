@@ -1,0 +1,101 @@
+"""strategy-interface: every parallelism strategy implements the full
+contract.
+
+The CLAUDE.md hard rule (stated in parallel/data_parallel.py): new
+parallelism is a new strategy object exposing ``variable_shardings``,
+``shard_state``, ``shard_batch`` and ``num_devices`` — never Trainer
+changes. A class that implements *some* of the contract is the dangerous
+case: it duck-types far enough to be passed as a strategy and then fails
+(or silently mis-shards) at the first missing method.
+
+Heuristic, scoped to files under a ``parallel/`` directory: any class
+defining at least one contract member must define all four. Members
+inherited from same-file bases count (``HybridFSDP(FSDP)``); a class with
+an *unresolvable* base (imported from elsewhere) is skipped rather than
+guessed at. Classes defining none of the four (mesh helpers, checkpoint
+readers, model wrappers) are not strategies and stay out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import Rule, register
+
+REQUIRED = ("variable_shardings", "shard_state", "shard_batch", "num_devices")
+
+
+def _defined_members(cls: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            out.add(node.target.id)
+    return out
+
+
+@register
+class StrategyInterface(Rule):
+    id = "strategy-interface"
+    description = (
+        "classes in parallel/ implementing any of variable_shardings/"
+        "shard_state/shard_batch/num_devices must implement all four "
+        "(the uniform strategy contract the Trainer relies on)"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        if "parallel" not in ctx.path.parts:
+            return
+        classes = {
+            node.name: node
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.ClassDef)
+        }
+        for cls in classes.values():
+            members, resolvable = self._members_with_bases(cls, classes)
+            if not resolvable:
+                continue
+            present = members & set(REQUIRED)
+            if not present or len(present) == len(REQUIRED):
+                continue
+            missing = [m for m in REQUIRED if m not in members]
+            yield self.finding(
+                ctx, cls,
+                f"class {cls.name} implements {sorted(present)} but is "
+                f"missing {missing}; a strategy must implement the full "
+                "contract (see parallel/data_parallel.py)",
+            )
+
+    def _members_with_bases(
+        self, cls: ast.ClassDef, classes: dict[str, ast.ClassDef],
+        _seen: frozenset[str] = frozenset(),
+    ) -> tuple[set[str], bool]:
+        """(members incl. same-file inheritance, all bases resolvable?)"""
+        members = _defined_members(cls)
+        for base in cls.bases:
+            if isinstance(base, ast.Name):
+                if base.id == "object":
+                    continue
+                parent = classes.get(base.id)
+                if parent is None or parent.name in _seen:
+                    return members, False
+                inherited, ok = self._members_with_bases(
+                    parent, classes, _seen | {cls.name}
+                )
+                if not ok:
+                    return members, False
+                members |= inherited
+            else:
+                # Attribute/Call bases (imported, metaclass factories):
+                # cannot see their members — skip the class.
+                return members, False
+        return members, True

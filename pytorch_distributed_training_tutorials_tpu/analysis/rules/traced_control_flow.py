@@ -1,0 +1,141 @@
+"""traced-control-flow: no Python branching on traced values under jit.
+
+The CLAUDE.md hard rule: no data-dependent Python control flow in compiled
+code — ``if x > 0:`` on a tracer either raises
+``TracerBoolConversionError`` or (via ``bool``/``float``/``int``/
+``.item()``) forces a concretization; ``lax.cond``/``lax.scan``/
+``jnp.where`` are the compiled-code forms. Flagged inside every traced
+context (:mod:`..jitscope`): ``if``/``while``/ternary tests, ``for`` iters,
+``bool()/int()/float()`` casts and ``.item()`` whose expression references
+a traced parameter.
+
+What does NOT count as "referencing a traced parameter" — these are
+resolved at trace time from static structure and are the idiomatic way to
+steer compilation:
+
+- ``x.shape`` / ``x.ndim`` / ``x.dtype`` / ``x.size`` (static metadata),
+- ``len(x)`` / ``isinstance(x, ...)`` / ``hasattr`` / ``type`` calls,
+- ``x is None`` / ``x is not None`` (Python identity, common for optional
+  args like masks),
+- parameters named by ``static_argnums``/``static_argnames`` (honored by
+  the context discovery; a non-literal static spec skips the whole
+  context rather than guessing).
+
+Only *direct* parameter references are tracked — a value laundered through
+an assignment (``flag = x > 0; if flag:``) is out of scope for a
+single-pass AST rule; the runtime error still catches it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import Rule, register
+
+# Attributes of a tracer that are static python values at trace time.
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "aval", "sharding"})
+# Builtins whose result on a tracer is static (or that never concretize).
+STATIC_CALLS = frozenset({"len", "isinstance", "hasattr", "getattr", "type",
+                          "callable", "id", "repr", "str", "format"})
+# Builtins that concretize a tracer.
+CAST_CALLS = frozenset({"bool", "int", "float", "complex"})
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def refs_traced(node: ast.AST, traced: frozenset[str]) -> bool:
+    """Does ``node`` reference a traced parameter in a value position?"""
+    if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in STATIC_CALLS:
+            return False
+    if isinstance(node, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+    ):
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, _FUNCS):
+        return False  # a nested function gets its own traced context
+    return any(refs_traced(c, traced) for c in ast.iter_child_nodes(node))
+
+
+def _body_nodes(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk a traced body without descending into nested functions (they
+    are separate contexts with their own traced-parameter sets)."""
+    if isinstance(func, ast.Lambda):
+        roots = [func.body]
+    else:
+        roots = list(func.body)
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNCS):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class TracedControlFlow(Rule):
+    id = "traced-control-flow"
+    description = (
+        "no Python if/while/for/bool()/float()/.item() on traced arguments "
+        "inside jit/pjit/shard_map/remat code (use lax.cond/scan/where); "
+        "static_argnums is honored"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for jc in ctx.jit_contexts:
+            if jc.unknown_statics or not jc.traced:
+                continue
+            yield from self._check(ctx, jc)
+
+    def _check(self, ctx, jc) -> Iterator[Finding]:
+        traced = jc.traced
+        where = f"in traced code ({jc.name}, via {jc.wrapper})"
+        for node in _body_nodes(jc.func):
+            if isinstance(node, (ast.If, ast.While)):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                if refs_traced(node.test, traced):
+                    yield self.finding(
+                        ctx, node,
+                        f"Python `{kind}` on a traced argument {where}; "
+                        "use lax.cond/jnp.where (or mark the argument "
+                        "static)",
+                    )
+            elif isinstance(node, ast.IfExp):
+                if refs_traced(node.test, traced):
+                    yield self.finding(
+                        ctx, node,
+                        f"ternary on a traced argument {where}; use "
+                        "jnp.where/lax.select",
+                    )
+            elif isinstance(node, ast.For):
+                if refs_traced(node.iter, traced):
+                    yield self.finding(
+                        ctx, node,
+                        f"Python `for` over a traced argument {where}; "
+                        "use lax.scan/lax.fori_loop",
+                    )
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Name) and f.id in CAST_CALLS
+                        and any(refs_traced(a, traced) for a in node.args)):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{f.id}()` concretizes a traced argument {where}; "
+                        "compute with jnp ops instead",
+                    )
+                elif (isinstance(f, ast.Attribute) and f.attr == "item"
+                        and not node.args
+                        and refs_traced(f.value, traced)):
+                    yield self.finding(
+                        ctx, node,
+                        f"`.item()` concretizes a traced argument {where}; "
+                        "it forces a host sync and fails under jit",
+                    )

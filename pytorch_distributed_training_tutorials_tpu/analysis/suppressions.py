@@ -1,0 +1,77 @@
+"""Inline suppressions: ``# graftcheck: disable=<rule>[,<rule>] -- <reason>``.
+
+The reason is mandatory — a suppression is a claim that the flagged code is
+safe, and the claim is worthless without the why (mirroring how CLAUDE.md
+records *why* each trap is a trap). A reasonless or unknown-rule
+suppression is itself reported (rule id ``bad-suppression``).
+
+Placement: a trailing comment suppresses findings reported on its own line;
+a comment alone on a line suppresses findings on the next code line. Real
+comments are found with :mod:`tokenize`, so the marker inside a string
+literal is inert.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+
+_MARKER = re.compile(
+    r"#\s*graftcheck:\s*disable=(?P<rules>[A-Za-z0-9_,\- ]+?)"
+    r"(?:\s+--\s*(?P<reason>.*\S))?\s*$"
+)
+
+
+@dataclass
+class Suppression:
+    comment_line: int        # where the comment physically sits
+    target_line: int         # which code line it silences
+    rules: frozenset[str]
+    reason: str | None
+
+
+def collect(source: str) -> list[Suppression]:
+    """All graftcheck suppression comments in ``source``.
+
+    Tolerates files that tokenize cannot fully process (the engine already
+    reports those as parse errors); whatever tokenized before the failure
+    is still honored.
+    """
+    comments: list[tuple[int, bool, str]] = []  # (line, standalone, text)
+    code_lines: set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                line_text = tok.line[: tok.start[1]]
+                standalone = not line_text.strip()
+                comments.append((tok.start[0], standalone, tok.string))
+            elif tok.type not in (
+                tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                tokenize.DEDENT, tokenize.ENDMARKER, tokenize.ENCODING,
+            ):
+                code_lines.add(tok.start[0])
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+
+    out: list[Suppression] = []
+    for line, standalone, text in comments:
+        m = _MARKER.search(text)
+        if not m:
+            continue
+        rules = frozenset(
+            r.strip() for r in m.group("rules").split(",") if r.strip()
+        )
+        if standalone:
+            later = [ln for ln in code_lines if ln > line]
+            target = min(later) if later else line + 1
+        else:
+            target = line
+        out.append(Suppression(
+            comment_line=line,
+            target_line=target,
+            rules=rules,
+            reason=m.group("reason"),
+        ))
+    return out

@@ -28,7 +28,10 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from flax import struct
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 exposes it under experimental only
+    from jax.experimental.shard_map import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P

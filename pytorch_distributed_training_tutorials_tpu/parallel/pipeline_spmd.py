@@ -37,7 +37,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 exposes it under experimental only
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (

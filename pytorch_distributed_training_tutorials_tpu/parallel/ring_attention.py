@@ -31,7 +31,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5 exposes it under experimental only
+    from jax.experimental.shard_map import shard_map
 
 from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (
     DATA_AXIS,

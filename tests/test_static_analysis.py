@@ -1,0 +1,518 @@
+"""graftcheck: the rule engine that machine-checks CLAUDE.md's hard rules.
+
+Each rule gets a known-bad fixture asserting it fires at the right
+location and a clean twin asserting silence — including the
+default-argument import-purity case the runtime subprocess guard
+(test_import_purity.py) structurally cannot catch. Plus: suppression
+comments (reason mandatory), the CLI contract, and the tier-1 repo sweep
+— ``pytest tests/ -q`` fails on any new unsuppressed finding anywhere in
+the package, scripts, or examples.
+
+No jax needed anywhere here: the analysis package is pure stdlib, and
+``test_analysis_cli_imports_no_jax`` pins that property in a subprocess.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+from pytorch_distributed_training_tutorials_tpu.analysis import analyze_file, analyze_paths, all_rules
+from pytorch_distributed_training_tutorials_tpu.analysis.cli import main as cli_main
+from pytorch_distributed_training_tutorials_tpu.analysis.engine import Config
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "pytorch_distributed_training_tutorials_tpu"
+SWEEP_PATHS = [PKG, REPO / "scripts", REPO / "examples"]
+
+
+def check(src: str, path: str = "fixture/mod.py", config: Config | None = None):
+    """Run all rules over a source string under a synthetic path."""
+    return analyze_file(Path(path), config=config, source=textwrap.dedent(src))
+
+
+def hits(findings, rule: str):
+    return [f for f in findings if f.rule == rule and not f.suppressed]
+
+
+# ---------------------------------------------------------------- import-purity
+
+BAD_PURITY = """
+    import jax
+    import jax.numpy as jnp
+
+    NEG_INF = jnp.float32(-1e30)
+
+    def f(x, pad=jnp.zeros((3,))):
+        return x + pad
+
+    class C:
+        scale = jnp.ones(())
+"""
+
+
+def test_import_purity_fires_on_module_constant():
+    found = hits(check(BAD_PURITY), "import-purity")
+    assert any(f.line == 5 and "module-level" in f.message for f in found)
+
+
+def test_import_purity_fires_on_default_argument():
+    # THE case the runtime subprocess guard cannot catch: the default
+    # evaluates at `def` time, long before anything calls f.
+    found = hits(check(BAD_PURITY), "import-purity")
+    assert any(f.line == 7 and "default-argument" in f.message for f in found)
+
+
+def test_import_purity_fires_on_class_attribute():
+    found = hits(check(BAD_PURITY), "import-purity")
+    assert any(f.line == 11 and "class-attribute" in f.message for f in found)
+
+
+def test_import_purity_clean_twin_is_silent():
+    clean = """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec
+
+        SPEC = PartitionSpec("data")          # metadata: no backend touch
+
+        @jax.jit
+        def f(x, dtype=jnp.float32):          # attribute ref, not a call
+            return jnp.zeros_like(x, dtype)   # call-time: fine
+
+        g = jax.jit(lambda x: x * 2)          # transform constructor: fine
+
+        if __name__ == "__main__":
+            print(f(jnp.ones((2,))))          # entry point: fine
+    """
+    assert not hits(check(clean), "import-purity")
+
+
+def test_import_purity_fires_on_backend_probe():
+    found = hits(check("import jax\nN = jax.device_count()\n"),
+                 "import-purity")
+    assert len(found) == 1 and found[0].line == 2
+
+
+# ---------------------------------------------------------- traced-control-flow
+
+def test_traced_control_flow_fires_per_construct():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                pass
+            while x:
+                pass
+            for v in x:
+                pass
+            y = float(x)
+            z = x.item()
+            return x
+    """
+    found = hits(check(src), "traced-control-flow")
+    assert [f.line for f in found] == [6, 8, 10, 12, 13]
+
+
+def test_traced_control_flow_honors_static_argnums_and_argnames():
+    src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,),
+                           static_argnames=("mode",))
+        def f(x, flag, *, mode="a"):
+            if flag:
+                pass
+            if mode == "a":
+                pass
+            return x
+    """
+    assert not hits(check(src), "traced-control-flow")
+
+
+def test_traced_control_flow_sees_call_site_wrapping():
+    src = """
+        import jax
+
+        def step(state, batch):
+            if batch:
+                pass
+            return state
+
+        step_jit = jax.jit(step, donate_argnums=0)
+    """
+    found = hits(check(src), "traced-control-flow")
+    assert len(found) == 1 and found[0].line == 5
+
+
+def test_traced_control_flow_sees_nested_scan_body():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(xs):
+            def body(carry, x):
+                if x > 0:
+                    pass
+                return carry, x
+            return jax.lax.scan(body, 0.0, xs)
+    """
+    found = hits(check(src), "traced-control-flow")
+    assert len(found) == 1 and found[0].line == 7
+
+
+def test_traced_control_flow_clean_twin_is_silent():
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is None:                  # identity: trace-time python
+                mask = jnp.ones_like(x)
+            if x.shape[0] > 1:                # shapes are static
+                pass
+            if len(x) > 1:                    # len is static
+                pass
+            return jax.lax.cond(x.sum() > 0, lambda v: v, lambda v: -v, x)
+    """
+    assert not hits(check(src), "traced-control-flow")
+
+
+def test_traced_control_flow_skips_unresolvable_statics():
+    # A non-literal static spec: skipping beats guessing wrong.
+    src = """
+        import functools
+        import jax
+
+        STATICS = (1,)
+
+        @functools.partial(jax.jit, static_argnums=STATICS)
+        def f(x, flag):
+            if flag:
+                pass
+            return x
+    """
+    assert not hits(check(src), "traced-control-flow")
+
+
+def test_traced_control_flow_sees_nn_remat_class_with_statics():
+    # The models/transformer.py idiom: argnums count self as 0.
+    src = """
+        import flax.linen as nn
+
+        class Block(nn.Module):
+            def __call__(self, x, decode, prefill):
+                if decode:
+                    pass
+                if prefill:
+                    pass
+                if x.sum() > 0:
+                    pass
+                return x
+
+        Wrapped = nn.remat(Block, static_argnums=(2, 3))
+    """
+    found = hits(check(src), "traced-control-flow")
+    assert [f.line for f in found] == [10]  # only the `if x.sum() > 0`
+
+
+# -------------------------------------------------------------- host-sync-hazard
+
+def test_host_sync_fires_inside_jit():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            a = np.asarray(x)
+            b = jax.device_get(x)
+            x.block_until_ready()
+            return x
+    """
+    found = hits(check(src), "host-sync-hazard")
+    assert [f.line for f in found] == [7, 8, 9]
+
+
+def test_host_sync_silent_outside_jit():
+    src = """
+        import time
+        import jax
+        import numpy as np
+
+        def timed_leg(fn, x):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(x))      # the harness idiom: deliberate
+            host = np.asarray(jax.device_get(x))
+            return time.perf_counter() - t0, host
+    """
+    assert not hits(check(src), "host-sync-hazard")
+
+
+# ------------------------------------------------------------ strategy-interface
+
+def test_strategy_interface_fires_on_partial_contract():
+    src = """
+        class HalfStrategy:
+            def shard_batch(self, b):
+                return b
+
+            def shard_state(self, s):
+                return s
+    """
+    found = hits(check(src, path="pkg/parallel/bad.py"), "strategy-interface")
+    assert len(found) == 1
+    f = found[0]
+    assert "HalfStrategy" in f.message
+    assert "variable_shardings" in f.message and "num_devices" in f.message
+
+
+def test_strategy_interface_full_contract_and_inheritance_silent():
+    src = """
+        class Full:
+            @property
+            def num_devices(self):
+                return 1
+
+            def variable_shardings(self, v):
+                return v
+
+            def shard_state(self, s):
+                return s
+
+            def shard_batch(self, b):
+                return b
+
+        class Hybrid(Full):                   # inherits the rest
+            def shard_batch(self, b):
+                return b
+
+        class NotAStrategy:                   # none of the contract: out of scope
+            def helper(self):
+                pass
+    """
+    assert not hits(check(src, path="pkg/parallel/ok.py"), "strategy-interface")
+
+
+def test_strategy_interface_scoped_to_parallel_dirs():
+    src = """
+        class Partial:
+            def shard_batch(self, b):
+                return b
+    """
+    assert not hits(check(src, path="pkg/models/whatever.py"),
+                    "strategy-interface")
+
+
+# ------------------------------------------------------------ reference-citation
+
+def _ref_config(tmp_path: Path) -> Config:
+    root = tmp_path / "reference"
+    root.mkdir(exist_ok=True)
+    (root / "ddp_gpus.py").write_text("\n".join(f"l{i}" for i in range(1, 51)))
+    return Config(reference_root=root, repo_root=tmp_path / "norepo")
+
+
+def test_reference_citation_fires_past_eof(tmp_path):
+    src = '''
+        """Twin of ddp_gpus.py:400 (past the end)."""
+    '''
+    found = hits(check(src, config=_ref_config(tmp_path)), "reference-citation")
+    assert len(found) == 1 and "past the end" in found[0].message
+
+
+def test_reference_citation_resolving_citation_silent(tmp_path):
+    src = '''
+        """Twin of ddp_gpus.py:50 (the last line) and ddp_gpus.py:1."""
+    '''
+    assert not hits(check(src, config=_ref_config(tmp_path)),
+                    "reference-citation")
+
+
+def test_reference_citation_fires_on_missing_file(tmp_path):
+    src = '''
+        """Twin of nonexistent_lesson.py:3."""
+    '''
+    found = hits(check(src, config=_ref_config(tmp_path)), "reference-citation")
+    assert len(found) == 1 and "not found" in found[0].message
+
+
+def test_reference_citation_malformed_fires_without_reference_tree(tmp_path):
+    src = '''
+        """See ddp_gpus.py:somewhere for details."""
+    '''
+    cfg = Config(reference_root=tmp_path / "absent", repo_root=tmp_path)
+    found = hits(check(src, config=cfg), "reference-citation")
+    assert len(found) == 1 and "malformed" in found[0].message
+
+
+def test_reference_citation_absent_tree_skips_resolution(tmp_path):
+    src = '''
+        """Twin of ddp_gpus.py:400 — unresolvable without the tree."""
+    '''
+    cfg = Config(reference_root=tmp_path / "absent", repo_root=tmp_path)
+    assert not hits(check(src, config=cfg), "reference-citation")
+
+
+def test_reference_citation_pytest_node_ids_are_not_citations(tmp_path):
+    src = '''
+        """Pinned by tests/test_gpipe.py::test_dispatch_count."""
+    '''
+    cfg = Config(reference_root=tmp_path / "absent", repo_root=tmp_path)
+    assert not hits(check(src, config=cfg), "reference-citation")
+
+
+# ----------------------------------------------------------------- suppressions
+
+SUPPRESSED = """
+    import jax.numpy as jnp
+
+    A = jnp.zeros((2,))  # graftcheck: disable=import-purity -- fixture constant, module never imported by workers
+"""
+
+
+def test_suppression_with_reason_suppresses():
+    findings = check(SUPPRESSED)
+    assert not hits(findings, "import-purity")
+    sup = [f for f in findings if f.suppressed]
+    assert len(sup) == 1
+    assert "never imported by workers" in sup[0].suppress_reason
+
+
+def test_suppression_without_reason_is_itself_a_finding():
+    src = """
+        import jax.numpy as jnp
+
+        A = jnp.zeros((2,))  # graftcheck: disable=import-purity
+    """
+    findings = check(src)
+    assert hits(findings, "import-purity"), "reasonless must not suppress"
+    assert hits(findings, "bad-suppression")
+
+
+def test_suppression_unknown_rule_is_flagged_and_inert():
+    src = """
+        import jax.numpy as jnp
+
+        A = jnp.zeros((2,))  # graftcheck: disable=not-a-rule -- whatever
+    """
+    findings = check(src)
+    assert hits(findings, "import-purity")
+    assert hits(findings, "bad-suppression")
+
+
+def test_standalone_suppression_covers_next_code_line():
+    src = """
+        import jax.numpy as jnp
+
+        # graftcheck: disable=import-purity -- fixture constant for the test below
+        A = jnp.zeros((2,))
+    """
+    assert not hits(check(src), "import-purity")
+
+
+def test_suppression_marker_inside_string_is_inert():
+    src = """
+        import jax.numpy as jnp
+
+        MSG = "# graftcheck: disable=import-purity -- not a comment"
+        A = jnp.zeros((2,))
+    """
+    assert hits(check(src), "import-purity")
+
+
+def test_suppression_only_silences_named_rule():
+    src = """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            if x > 0:  # graftcheck: disable=host-sync-hazard -- wrong rule named
+                pass
+            return x
+    """
+    assert hits(check(src), "traced-control-flow")
+
+
+# ----------------------------------------------------------------- engine / CLI
+
+def test_parse_error_is_reported_not_raised(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    findings = analyze_file(bad)
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.numpy as jnp\nA = jnp.zeros((2,))\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("import jax.numpy as jnp\n\ndef f(x):\n    return jnp.sum(x)\n")
+
+    assert cli_main([str(clean)]) == 0
+    assert cli_main([str(bad)]) == 1
+    assert cli_main([str(bad), "--select", "traced-control-flow"]) == 0
+    assert cli_main(["--select", "no-such-rule", str(bad)]) == 2
+    assert cli_main([str(tmp_path / "missing_dir_or_file.py")]) == 2
+    capsys.readouterr()
+
+    assert cli_main([str(bad), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["unsuppressed"] == 1
+    assert report["findings"][0]["rule"] == "import-purity"
+    assert report["findings"][0]["line"] == 2
+
+    assert cli_main(["--list-rules"]) == 0
+    listing = capsys.readouterr().out
+    for rid in all_rules():
+        assert rid in listing
+
+
+# ------------------------------------------------------------- the tier-1 sweep
+
+def test_repo_sweep_has_zero_unsuppressed_findings():
+    """THE enforcement hook: any new hard-rule violation anywhere in the
+    package, scripts, or examples fails the suite."""
+    findings, n_files = analyze_paths(SWEEP_PATHS)
+    bad = [f for f in findings if not f.suppressed]
+    assert n_files > 60, f"sweep saw only {n_files} files — wrong cwd?"
+    assert not bad, "unsuppressed graftcheck findings:\n" + "\n".join(
+        f.render() for f in bad
+    )
+
+
+def test_every_suppression_in_tree_carries_a_reason():
+    findings, _ = analyze_paths(SWEEP_PATHS)
+    assert not [f for f in findings if f.rule == "bad-suppression"]
+
+
+def test_analysis_cli_imports_no_jax_and_is_fast():
+    """Acceptance pin: the CLI sweep imports no jax (nor numpy/flax) and
+    finishes well under the 10 s budget."""
+    code = (
+        "import sys\n"
+        "from pytorch_distributed_training_tutorials_tpu.analysis.cli import main\n"
+        "rc = main([%r, %r, %r])\n"
+        "heavy = [m for m in sys.modules if m == 'jax' or "
+        "m.startswith(('jax.', 'jaxlib', 'numpy', 'flax', 'optax'))]\n"
+        "assert rc == 0, 'sweep not clean: rc=%%d' %% rc\n"
+        "assert not heavy, 'analysis imported: %%s' %% heavy\n"
+        "print('NO_JAX_OK')\n"
+    ) % tuple(str(p) for p in SWEEP_PATHS)
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO),
+    )
+    elapsed = time.monotonic() - t0
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "NO_JAX_OK" in out.stdout
+    assert elapsed < 10, f"sweep took {elapsed:.1f}s (budget: 10s)"
