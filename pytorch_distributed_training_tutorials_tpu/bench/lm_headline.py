@@ -25,6 +25,12 @@ Round-5 tuning (TRAIN_LLM_r05.md, measured on the v5e lite chip):
   flash(1024,1024), remat="dots", unrolled, 12-step chain ->
   52.1%% MFU wall (53.9%% device-rate), 15.5k tok/s.
 
+``--fused`` runs a second arm with the memory-bound tail fused away —
+logits-free blockwise cross entropy (ops.fused_loss; the (B, S, V) logits
+never materialize) plus single-pass fused AdamW (ops.fused_optim) — and
+emits BOTH arms in the JSON ({"baseline": ..., "fused": ...}): the receipt
+for what the fused tail buys at fixed HBM.
+
 Run:  python -m pytorch_distributed_training_tutorials_tpu.bench.lm_headline [--json out.json]
 Sweep CLI with the full tuning grid: scripts/train_llm_mfu.py.
 """
@@ -47,14 +53,6 @@ PRESETS = {
     "350m": (1024, 24, 16, 32768),
     "760m": (1536, 24, 16, 32768),
 }
-
-
-def model_flops_per_token(n_params_nonembed: int, d_model: int,
-                          n_layers: int, seq_len: int) -> float:
-    """Training FLOPs per token, PaLM appendix-B convention: 6x the
-    non-embedding params (fwd 2x + bwd 4x) plus 12*L*d*S for the two
-    attention einsums (QK^T and weights@V, fwd+bwd)."""
-    return 6.0 * n_params_nonembed + 12.0 * n_layers * d_model * seq_len
 
 
 def build(args):
@@ -94,7 +92,15 @@ def build(args):
     params = jax.jit(model.init)(key, jnp.zeros((1, args.seq), jnp.int32))[
         "params"
     ]
-    tx = optax.adamw(3e-4, weight_decay=0.01)
+    fused = getattr(args, "fused", False)
+    if fused:
+        from pytorch_distributed_training_tutorials_tpu.ops.fused_optim import (
+            fused_adamw,
+        )
+
+        tx = fused_adamw(3e-4, weight_decay=0.01)
+    else:
+        tx = optax.adamw(3e-4, weight_decay=0.01)
     state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
     rng = np.random.Generator(np.random.PCG64(0))
@@ -102,7 +108,10 @@ def build(args):
         rng.integers(0, vocab, (args.batch, args.seq + 1)), jnp.int32
     )
     batch = (toks[:, :-1], toks[:, 1:])
-    step_fn = _train_step_fn("cross_entropy", has_batch_stats=False)
+    step_fn = _train_step_fn(
+        "fused_cross_entropy" if fused else "cross_entropy",
+        has_batch_stats=False,
+    )
 
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     # embedding + lm_head don't do 6N of matmul work per token
@@ -164,7 +173,15 @@ def measure(args) -> dict:
     cost = (
         jax.jit(step_fn).lower(state, batch).compile().cost_analysis()
     )
+    if isinstance(cost, (list, tuple)):  # CPU backend: one dict per device
+        cost = cost[0] if cost else {}
     executed_flops = float(cost.get("flops", 0.0))
+
+    # the one scan-aware analytic MFU numerator (models.utils has the
+    # cost_analysis caveat)
+    from pytorch_distributed_training_tutorials_tpu.models.utils import (
+        model_flops_per_token,
+    )
 
     d_model, n_layers, _, vocab = PRESETS[args.preset]
     tokens_per_step = args.batch * args.seq
@@ -187,6 +204,7 @@ def measure(args) -> dict:
     wall = min(samples)
     step_s = wall / args.steps
 
+    fused = getattr(args, "fused", False)
     out = {
         "preset": args.preset,
         "d_model": d_model,
@@ -194,6 +212,8 @@ def measure(args) -> dict:
         "vocab": vocab,
         "seq": args.seq,
         "batch": args.batch,
+        "loss": "fused_cross_entropy" if fused else "cross_entropy",
+        "optimizer": "fused_adamw" if fused else "adamw",
         "attn": args.attn
         + (f"({args.block_q},{args.block_k})" if args.attn == "flash" else ""),
         "remat": bool(args.remat),
@@ -265,6 +285,10 @@ def parse(argv=None):
     p.add_argument("--reps", type=int, default=3)
     p.add_argument("--trace", action="store_true")
     p.add_argument("--mem_only", action="store_true")
+    p.add_argument("--fused", action="store_true",
+                   help="also run the fused-tail arm (logits-free "
+                   "ops.fused_loss + ops.fused_optim AdamW) and emit both "
+                   "arms in the JSON")
     p.add_argument("--json", default=None)
     return p.parse_args(argv)
 
@@ -275,7 +299,14 @@ def main() -> None:
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     args = parse()
-    r = measure(args)
+    if args.fused:
+        # side-by-side receipt: identical model/batch/chain, only the
+        # loss+optimizer tail differs between the arms
+        base = argparse.Namespace(**vars(args))
+        base.fused = False
+        r = {"baseline": measure(base), "fused": measure(args)}
+    else:
+        r = measure(args)
     print(json.dumps(r))
     if args.json:
         with open(args.json, "w") as f:

@@ -538,12 +538,23 @@ class _ScanCell(nn.Module):
 
 
 class TransformerLM(nn.Module):
-    """Causal LM: tokens (B, S) int32 -> logits (B, S, vocab)."""
+    """Causal LM: tokens (B, S) int32 -> logits (B, S, vocab).
+
+    ``return_hidden=True`` stops before the lm_head and returns the
+    final-norm hidden states (B, S, d_model) instead — the seam the fused
+    logits-free loss (:mod:`..ops.fused_loss`) trains through.
+    """
 
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, decode: bool = False, prefill: bool = False):
+    def __call__(
+        self,
+        tokens,
+        decode: bool = False,
+        prefill: bool = False,
+        return_hidden: bool = False,
+    ):
         cfg = self.cfg
         if cfg.quantized and cfg.moe_experts:
             raise ValueError(
@@ -591,6 +602,14 @@ class TransformerLM(nn.Module):
             # head is the single largest matmul in the prefill
             x = x[:, -1:]
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
+        if return_hidden:
+            # the fused-loss seam: final-norm hidden states (B, S, d_model),
+            # lm_head NOT applied — ops.fused_loss streams them against the
+            # lm_head kernel blockwise so the (B, S, vocab) logits never
+            # materialize (train.trainer loss="fused_cross_entropy"). The
+            # lm_head param still exists (init runs without this flag);
+            # grads reach it through the fused op, not this module.
+            return x
         if cfg.quantized:
             from pytorch_distributed_training_tutorials_tpu.ops.quant import Int8Dense
 

@@ -15,6 +15,15 @@ from pytorch_distributed_training_tutorials_tpu.ops.flash_attention import (  # 
     flash_attention,
     make_flash_attention,
 )
+from pytorch_distributed_training_tutorials_tpu.ops.fused_loss import (  # noqa: F401
+    fused_cross_entropy,
+    fused_cross_entropy_reference,
+    fused_cross_entropy_tp,
+)
+from pytorch_distributed_training_tutorials_tpu.ops.fused_optim import (  # noqa: F401
+    FusedAdamWState,
+    fused_adamw,
+)
 from pytorch_distributed_training_tutorials_tpu.ops.quant import (  # noqa: F401
     Int8Dense,
     Int8Param,

@@ -28,13 +28,13 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from flax import struct
-try:
-    from jax import shard_map
-except ImportError:  # jax < 0.5 exposes it under experimental only
-    from jax.experimental.shard_map import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_training_tutorials_tpu.utils.compat import (
+    shard_map_nocheck,
+)
 
 
 class Int8Param(struct.PyTreeNode):
@@ -261,10 +261,11 @@ def int8_matmul_tp(
     else:
         raise ValueError(f"kind must be 'column' or 'row', got {kind!r}")
 
-    # check_vma=False: pallas_call outputs carry no varying-mesh-axes info
-    return shard_map(
+    # checking off: pallas_call outputs carry no replication/varying-axes
+    # info for shard_map's static checker (check_rep/check_vma by jax
+    # version — utils.compat owns the drift)
+    return shard_map_nocheck(
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-        check_vma=False,
     )(x, w.q, scale_row)
 
 

@@ -17,7 +17,12 @@ reshuffle. The differences are the TPU-native ones (SURVEY.md section 7):
 
 Loss functions mirror the reference's: ``cross_entropy``
 (``F.cross_entropy``, ``ddp_gpus.py:37``) and ``mse`` (the model-parallel
-lesson, ``03.model_parallel.ipynb:991``).
+lesson, ``03.model_parallel.ipynb:991``). ``fused_cross_entropy`` is the
+same objective computed logits-free: the model is applied with
+``return_hidden=True`` and :func:`..ops.fused_loss.fused_cross_entropy`
+streams the final hidden states against the ``lm_head`` kernel blockwise,
+so the (B, S, vocab) logits tensor — the largest activation of an LM train
+step — never exists in HBM.
 """
 
 from __future__ import annotations
@@ -31,6 +36,9 @@ import optax
 from flax import core, struct
 
 from pytorch_distributed_training_tutorials_tpu.models.moe import moe_aux_loss
+from pytorch_distributed_training_tutorials_tpu.ops.fused_loss import (
+    fused_cross_entropy,
+)
 from pytorch_distributed_training_tutorials_tpu.parallel.data_parallel import (
     DataParallel,
 )
@@ -97,6 +105,22 @@ def create_train_state(
     return strategy.shard_state(state)
 
 
+def _fused_ce_loss(params, hidden, targets):
+    """Mean logits-free cross entropy: final hidden states streamed against
+    the model's own ``lm_head`` kernel (cast to the activation dtype, the
+    same cast ``nn.Dense(dtype=cfg.dtype)`` applies before its matmul)."""
+    if "lm_head" not in params:
+        raise ValueError(
+            'loss="fused_cross_entropy" needs a model with an lm_head '
+            "Dense whose forward supports return_hidden=True "
+            "(models.transformer.TransformerLM)"
+        )
+    w = params["lm_head"]["kernel"]
+    return fused_cross_entropy(
+        hidden, w.astype(hidden.dtype), targets
+    ).mean()
+
+
 def _compute_loss(loss: str, logits, targets):
     if loss == "cross_entropy":
         if targets.ndim == logits.ndim:  # one-hot / soft targets
@@ -116,6 +140,8 @@ def _make_loss_fn(
     step, the epoch scan, and the gradient-accumulation step — one place
     owns the batch_stats/mutable/aux-loss contract."""
 
+    fused = loss == "fused_cross_entropy"
+
     def loss_fn(params, state: TrainState, batch):
         x, y = batch
         variables = {"params": params}
@@ -127,13 +153,20 @@ def _make_loss_fn(
             kwargs["train"] = True
         if aux_loss_weight:
             mutable.append("losses")
+        if fused:
+            # fused tail: the model stops at the final-norm hidden states;
+            # the lm_head matmul happens inside the blockwise loss kernel
+            kwargs["return_hidden"] = True
         if mutable:
             out, updates = state.apply_fn(
                 variables, x, mutable=mutable, **kwargs
             )
         else:
-            out, updates = state.apply_fn(variables, x), {}
-        loss_val = _compute_loss(loss, out, y)
+            out, updates = state.apply_fn(variables, x, **kwargs), {}
+        if fused:
+            loss_val = _fused_ce_loss(params, out, y)
+        else:
+            loss_val = _compute_loss(loss, out, y)
         if aux_loss_weight:
             loss_val = loss_val + aux_loss_weight * moe_aux_loss(updates)
         return loss_val, updates.get("batch_stats")
@@ -194,6 +227,12 @@ def make_train_step(
 
     ``aux_loss_weight`` > 0 collects the model's sown ``"losses"`` collection
     (MoE load-balancing) and adds it, weighted, to the objective.
+
+    ``loss="fused_cross_entropy"`` trains an LM through the logits-free
+    blockwise head+loss (:mod:`..ops.fused_loss`) — same objective as
+    ``"cross_entropy"``, minus the (B, S, vocab) logits activation. Also
+    accepted by :func:`make_epoch_scan` and the gradient-accumulation step
+    (they all share one loss definition).
 
     ``grad_accum_steps`` > 1 splits the batch into that many microbatches
     inside the compiled step (a ``lax.scan``), averaging gradients (and
@@ -348,7 +387,14 @@ def make_eval_step(loss: str = "cross_entropy", has_batch_stats: bool = False):
     :meth:`..data.loader.ShardedLoader.valid_mask`). ``correct`` is an
     argmax-accuracy count for integer-label cross-entropy and 0 otherwise
     (regression has no accuracy).
+
+    A ``"fused_cross_entropy"`` trainer evaluates through the standard
+    logits path: eval needs the argmax anyway, and one forward per eval
+    batch has no optimizer state competing for HBM — same objective,
+    same numbers.
     """
+    if loss == "fused_cross_entropy":
+        loss = "cross_entropy"
 
     def eval_fn(state: TrainState, batch, mask):
         x, y = batch

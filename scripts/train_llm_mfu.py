@@ -6,31 +6,21 @@ architecture-capped). This script measures what fraction of the v5e's
 197 bf16 TFLOP/s a full `TransformerLM` train step achieves — the
 standard headline metric for a distributed-training framework — and
 sweeps the knobs that move it (remat, attention kernel + block sizes,
-batch, sequence length).
+batch, sequence length, the fused loss/optimizer tail).
 
-Methodology (per CLAUDE.md's tunnel rules):
-- the measured program is a jitted ``lax.scan`` chain of N train steps on
-  a cached device-resident batch — ONE launch + ONE terminal fetch, so
-  the ~75-130 ms per-launch tunnel cost amortizes to noise;
-- wall time is min-of-3 with a real scalar fetch closing each run;
-- FLOPs come two ways and both are reported:
-  * **model FLOPs** (the MFU numerator, PaLM convention): ``6*N_params``
-    per token for the matmuls + ``12*L*d_model*S`` per token for
-    attention scores/context (no causality discount) — remat recompute
-    does NOT count, so remat honestly lowers MFU unless it buys a bigger
-    batch;
-  * **executed FLOPs** from XLA's cost analysis — reported raw but
-    KNOWN LOW on this stack: cost analysis counts a ``while``/scan body
-    once, not times n_layers (measured: 5.4 TF "executed" vs 52.8 TF
-    analytic on the 24-layer 350m step), so ``hw_util_executed`` is not
-    a utilization number when ``scan_layers`` is on;
-- ``--trace`` captures a device trace of the chain and reports the
-  trace-summed device time (the launch-free ground truth) alongside wall.
+The measurement engine (model build, lax.scan chain timing, analytic
+model-FLOPs numerator, tracing) is ``bench.lm_headline.measure`` — one
+copy of the methodology; this script owns only the sweep grid and its
+CLI defaults. Methodology notes live in that module's docstring; the
+scan-aware analytic-FLOPs caveat (XLA cost analysis counts a scan body
+once, not times n_layers) in ``models.utils.model_flops_per_token``.
 
 Run on the real chip:
 
     python scripts/train_llm_mfu.py --sweep --json sweep.json
     python scripts/train_llm_mfu.py --preset 350m --remat --trace
+    python scripts/train_llm_mfu.py --preset 350m --remat --remat_policy \
+        dots --no_scan --fused   # fused-tail arm vs baseline, side by side
 
 (The committed TRAIN_LLM_r05.json receipt comes from the tuned-winner
 CLI, ``python -m pytorch_distributed_training_tutorials_tpu.bench.lm_headline`` — 12-step chain;
@@ -45,215 +35,16 @@ CPU smoke (tiny shapes, correctness of the harness only):
 from __future__ import annotations
 
 import argparse
-import dataclasses
-import functools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-PEAK_BF16 = 197e12  # TPU v5e lite chip peak, bf16
-
-PRESETS = {
-    # name: (d_model, n_layers, n_heads, vocab)
-    "smoke": (64, 2, 4, 256),
-    "125m": (768, 12, 12, 32768),
-    "350m": (1024, 24, 16, 32768),
-    "760m": (1536, 24, 16, 32768),
-}
-
-
-def model_flops_per_token(n_params_nonembed: int, d_model: int,
-                          n_layers: int, seq_len: int) -> float:
-    """Training FLOPs per token, PaLM appendix-B convention: 6x the
-    non-embedding params (fwd 2x + bwd 4x) plus 12*L*d*S for the two
-    attention einsums (QK^T and weights@V, fwd+bwd)."""
-    return 6.0 * n_params_nonembed + 12.0 * n_layers * d_model * seq_len
-
-
-def build(args):
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from pytorch_distributed_training_tutorials_tpu.models import (
-        TransformerConfig, TransformerLM,
-    )
-    from pytorch_distributed_training_tutorials_tpu.ops.flash_attention import (
-        make_flash_attention,
-    )
-    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
-        TrainState, _train_step_fn,
-    )
-
-    d_model, n_layers, n_heads, vocab = PRESETS[args.preset]
-    attention_fn = None
-    if args.attn == "flash":
-        attention_fn = make_flash_attention(args.block_q, args.block_k)
-    cfg = TransformerConfig(
-        vocab_size=vocab,
-        d_model=d_model,
-        n_layers=n_layers,
-        n_heads=n_heads,
-        max_seq_len=args.seq,
-        dtype=jnp.bfloat16,
-        scan_layers=not args.no_scan,
-        remat=args.remat,
-        remat_policy=args.remat_policy,
-        attention_fn=attention_fn,
-    )
-    model = TransformerLM(cfg)
-    key = jax.random.PRNGKey(0)
-    params = jax.jit(model.init)(key, jnp.zeros((1, args.seq), jnp.int32))[
-        "params"
-    ]
-    tx = optax.adamw(3e-4, weight_decay=0.01)
-    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
-
-    rng = np.random.Generator(np.random.PCG64(0))
-    toks = jnp.asarray(
-        rng.integers(0, vocab, (args.batch, args.seq + 1)), jnp.int32
-    )
-    batch = (toks[:, :-1], toks[:, 1:])
-    step_fn = _train_step_fn("cross_entropy", has_batch_stats=False)
-
-    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
-    # embedding + lm_head don't do 6N of matmul work per token
-    n_embed = vocab * d_model  # tok_emb; lm_head IS a matmul, keep it
-    return model, state, batch, step_fn, n_params, n_embed
-
-
-def chain_fn(step_fn, batch, n_steps):
-    import jax
-
-    def body(state, _):
-        state, metrics = step_fn(state, batch)
-        return state, metrics["loss"]
-
-    # donate the carried state: without aliasing, argument + output trees
-    # double the resident optimizer state (measured: 350m B=4 remat probe
-    # reported 14.9 GiB peak un-donated)
-    @functools.partial(jax.jit, donate_argnums=0)
-    def chain(state):
-        return jax.lax.scan(body, state, None, length=n_steps)
-
-    return chain
-
-
-def measure(args) -> dict:
-    import jax
-
-    t_build = time.perf_counter()
-    model, state, batch, step_fn, n_params, n_embed = build(args)
-    jax.block_until_ready(state.params)
-
-    chain = chain_fn(step_fn, batch, args.steps)
-    compiled = chain.lower(state).compile()
-    compile_s = time.perf_counter() - t_build
-    mem = compiled.memory_analysis()
-    peak_gb = None
-    if mem is not None:
-        peak_gb = round(
-            (
-                getattr(mem, "temp_size_in_bytes", 0)
-                + getattr(mem, "argument_size_in_bytes", 0)
-                + getattr(mem, "output_size_in_bytes", 0)
-                - getattr(mem, "alias_size_in_bytes", 0)
-            )
-            / 2**30,
-            2,
-        )
-        print(f"# peak HBM (XLA estimate): {peak_gb} GiB", file=sys.stderr)
-        if args.mem_only:
-            return {
-                "preset": args.preset, "seq": args.seq,
-                "batch": args.batch, "attn": args.attn,
-                "remat": bool(args.remat), "peak_hbm_gib": peak_gb,
-                "compile_s": round(compile_s, 1),
-            }
-
-    # executed FLOPs from XLA's own cost model (single un-scanned step so
-    # scan-length bookkeeping can't distort it)
-    cost = (
-        jax.jit(step_fn).lower(state, batch).compile().cost_analysis()
-    )
-    executed_flops = float(cost.get("flops", 0.0))
-
-    d_model, n_layers, _, vocab = PRESETS[args.preset]
-    tokens_per_step = args.batch * args.seq
-    # lm_head participates in the 6N term; only tok_emb is excluded
-    mflops_tok = model_flops_per_token(
-        n_params - n_embed, d_model, n_layers, args.seq
-    )
-    model_flops = mflops_tok * tokens_per_step
-
-    # prime the process's first D2H fetch outside every timed region
-    state2, losses = compiled(state)
-    float(losses[-1])
-
-    samples = []
-    for _ in range(args.reps):
-        t0 = time.perf_counter()
-        state2, losses = compiled(state2)
-        float(losses[-1])  # close the region with a real fetch
-        samples.append(time.perf_counter() - t0)
-    wall = min(samples)
-    step_s = wall / args.steps
-
-    out = {
-        "preset": args.preset,
-        "d_model": d_model,
-        "n_layers": n_layers,
-        "vocab": vocab,
-        "seq": args.seq,
-        "batch": args.batch,
-        "attn": args.attn
-        + (f"({args.block_q},{args.block_k})" if args.attn == "flash" else ""),
-        "remat": bool(args.remat),
-        "remat_policy": args.remat_policy,
-        "scan_layers": not args.no_scan,
-        "n_params": n_params,
-        "steps_chained": args.steps,
-        "wall_s_samples": [round(s, 3) for s in samples],
-        "step_ms": round(step_s * 1e3, 2),
-        "tokens_per_s": round(tokens_per_step / step_s),
-        "model_tflops_per_step": round(model_flops / 1e12, 3),
-        "executed_tflops_per_step": round(executed_flops / 1e12, 3),
-        "mfu": round(model_flops / step_s / PEAK_BF16, 4),
-        "hw_util_executed": round(executed_flops / step_s / PEAK_BF16, 4),
-        "compile_s": round(compile_s, 1),
-        "peak_hbm_gib": peak_gb,
-        "backend": jax.default_backend(),
-    }
-
-    if args.trace:
-        import shutil
-
-        from pytorch_distributed_training_tutorials_tpu.utils import profiling
-
-        logdir = "/tmp/jax-trace-lm"
-        shutil.rmtree(logdir, ignore_errors=True)
-        with profiling.trace(logdir):
-            state2, losses = compiled(state2)
-            float(losses[-1])
-        durations = profiling.device_op_durations(logdir)
-        leaf_us = sum(
-            v
-            for k, v in durations.items()
-            if not (
-                k.startswith("jit_") or k.startswith("while") or k.isdigit()
-            )
-        )
-        dev_step_s = leaf_us / 1e6 / args.steps
-        out["trace_step_ms"] = round(dev_step_s * 1e3, 2)
-        out["trace_mfu"] = round(model_flops / dev_step_s / PEAK_BF16, 4)
-        out["trace_hw_util"] = round(
-            executed_flops / dev_step_s / PEAK_BF16, 4
-        )
-    return out
+from pytorch_distributed_training_tutorials_tpu.bench.lm_headline import (  # noqa: E402
+    PRESETS,
+    measure,
+)
 
 
 def parse(argv=None):
@@ -281,6 +72,12 @@ def parse(argv=None):
                    help="capture a device trace of one chain run")
     p.add_argument("--mem_only", action="store_true",
                    help="compile and report XLA peak-memory estimate only")
+    p.add_argument("--fused", action="store_true",
+                   help="fused tail: logits-free blockwise cross entropy "
+                   "(ops.fused_loss) + single-pass fused AdamW "
+                   "(ops.fused_optim). Single-point runs emit baseline and "
+                   "fused arms side by side; with --sweep every grid row "
+                   "runs fused")
     p.add_argument("--sweep", action="store_true",
                    help="run the round-5 tuning table instead of one point")
     p.add_argument("--json", default=None, help="write results JSON here")
@@ -331,6 +128,14 @@ def main() -> None:
                 }
             results.append(r)
             print(json.dumps(r))
+    elif args.fused:
+        # side-by-side arms, identical model/batch/chain (the
+        # bench.lm_headline --fused receipt shape)
+        base = argparse.Namespace(**vars(args))
+        base.fused = False
+        r = {"baseline": measure(base), "fused": measure(args)}
+        results.append(r)
+        print(json.dumps(r, indent=2))
     else:
         r = measure(args)
         results.append(r)

@@ -23,6 +23,11 @@ from pytorch_distributed_training_tutorials_tpu.ops.flash_attention import (
     make_flash_attention,
 )
 
+from helpers import requires_pallas_interpret
+
+# every test here executes the Pallas kernel in Mosaic-interpret mode
+pytestmark = requires_pallas_interpret
+
 
 def _qkv(b, s, h, d, dtype=jnp.float32, seed=0):
     keys = jax.random.split(jax.random.PRNGKey(seed), 3)

@@ -1,0 +1,114 @@
+"""prefetch_iterable lifecycle: no leaked producer threads, honest errors.
+
+The producer is a background thread (data/prefetch.py); its two failure
+modes are silent: a consumer that abandons the generator mid-epoch (break
+out of a training loop, an exception elsewhere) must not strand the
+producer blocked on a full queue, and a producer exception must surface in
+the consumer WITH the producer's traceback, not as a mystery hang or a
+bare re-raise losing the origin.
+"""
+
+import itertools
+import threading
+import time
+import traceback
+
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.data.prefetch import (
+    PrefetchLoader,
+    prefetch_iterable,
+)
+
+
+def _prefetch_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.name == "prefetch" and t.is_alive()
+    ]
+
+
+def _wait_no_new_threads(before, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(_prefetch_threads()) <= before:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_abandoned_consumer_joins_producer_promptly():
+    """Closing the consumer generator (what a `break` / GC does) must stop
+    the producer even while it is blocked on the bounded queue."""
+    before = len(_prefetch_threads())
+    it = prefetch_iterable(itertools.count(), depth=2)
+    assert next(it) == 0
+    # producer is now ahead, blocked on the full depth-2 queue
+    it.close()  # GeneratorExit -> the finally's stop.set() + join
+    assert _wait_no_new_threads(before), (
+        f"producer thread leaked: {_prefetch_threads()}"
+    )
+
+
+def test_exhausted_consumer_leaves_no_thread():
+    before = len(_prefetch_threads())
+    assert list(prefetch_iterable(iter(range(10)), depth=3)) == list(
+        range(10)
+    )
+    assert _wait_no_new_threads(before)
+
+
+def test_producer_exception_reraises_with_original_traceback():
+    def bad_source():
+        yield 1
+        yield 2
+        raise RuntimeError("boom in producer")
+
+    it = prefetch_iterable(bad_source(), depth=1)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom in producer") as excinfo:
+        list(it)
+    # the exception's traceback must include the producer frame — the
+    # re-raise carries err[0].__traceback__ from the producer thread
+    frames = traceback.extract_tb(excinfo.value.__traceback__)
+    assert any(f.name == "bad_source" for f in frames), [
+        f.name for f in frames
+    ]
+
+
+def test_exception_path_joins_producer():
+    before = len(_prefetch_threads())
+
+    def bad_source():
+        yield 1
+        raise ValueError("late failure")
+
+    with pytest.raises(ValueError, match="late failure"):
+        list(prefetch_iterable(bad_source(), depth=2))
+    assert _wait_no_new_threads(before)
+
+
+def test_prefetch_loader_abandoned_mid_epoch():
+    """The PrefetchLoader wrapper inherits the lifecycle: breaking out of
+    an epoch loop mid-iteration leaves no thread behind."""
+
+    class Loader:
+        def __iter__(self):
+            return iter(range(100))
+
+        def __len__(self):
+            return 100
+
+        def set_epoch(self, epoch):
+            pass
+
+    before = len(_prefetch_threads())
+    loader = PrefetchLoader(Loader(), prefetch=2)
+    for i, item in enumerate(loader):
+        if i == 3:
+            break  # abandons the generator; GC/close must join the thread
+    del loader
+    import gc
+
+    gc.collect()
+    assert _wait_no_new_threads(before)

@@ -129,18 +129,22 @@ def test_resident_rejects_batch_spec(mesh):
 
 def test_loss_decreases_resident_mnist(mesh):
     ds = mnist("train")
-    # 512 samples, downsampled 28x28 -> 14x14: XLA:CPU conv compile time
+    # 512 samples, downsampled 28x28 -> 7x7: XLA:CPU conv compile time
     # grows steeply with spatial size (measured 13s/44s/413s at 8/14/28 px
-    # on this 1-core host); the semantics under test don't depend on it.
+    # on the round-4 host; 73s/223s/~6min at 7/10/14 px on this one); the
+    # semantics under test — the compiled epoch scan trains from a
+    # device-resident dataset — don't depend on it. adam instead of
+    # high-lr SGD because 7 px is noisy enough to diverge under
+    # sgd(0.05, momentum=0.9) (deterministic: seed 0, fixed init).
     small = type(ds)(
-        (ds.arrays[0][:512, ::2, ::2], ds.arrays[1][:512]),
+        (ds.arrays[0][:512, ::4, ::4], ds.arrays[1][:512]),
         synthetic=ds.synthetic,
     )
-    resident = DeviceResidentLoader(small, 16, mesh, seed=0)
+    resident = DeviceResidentLoader(small, 8, mesh, seed=0)
     trainer = Trainer(
         resnet18(num_classes=10, stem="cifar"),
         resident,
-        optax.sgd(0.05, momentum=0.9),
+        optax.adam(1e-3),
         loss="cross_entropy",
     )
     first = trainer._run_epoch(0)["loss"]

@@ -106,7 +106,10 @@ def test_resnet_train_step_with_batch_stats():
 
     mesh = create_mesh({"data": 8})
     rng = np.random.Generator(np.random.PCG64(0))
-    x = rng.standard_normal((64, 16, 16, 3)).astype(np.float32)
+    # 8x8 images: this test checks batch_stats plumbing (finite loss,
+    # step count), not accuracy — XLA:CPU conv compile time dominates and
+    # grows steeply with spatial size (see test_resident's measurements)
+    x = rng.standard_normal((64, 8, 8, 3)).astype(np.float32)
     labels = rng.integers(0, 10, 64).astype(np.int32)
     loader = ShardedLoader(ArrayDataset((x, labels)), 4, mesh)
     trainer = Trainer(
