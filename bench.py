@@ -49,10 +49,14 @@ def main() -> None:
         "refuse to bench the synthetic surrogate, so the receipt can "
         "only be a real-data receipt",
     )
+    ap.add_argument(
+        "--quiet", action="store_true",
+        help="silence per-epoch trainer chatter on stderr (structured "
+        "metrics still record; the JSON line is unaffected)",
+    )
     args = ap.parse_args()
 
     import jax
-    import time
 
     import optax
 
@@ -65,13 +69,14 @@ def main() -> None:
         DeviceResidentLoader,
         mnist,
     )
+    from pytorch_distributed_training_tutorials_tpu.obs import DriftBracket, MinOfN, make_receipt
     from pytorch_distributed_training_tutorials_tpu.train import Trainer
 
     # the canonical workload (uint8-resident MNIST, bf16 cifar-stem
     # ResNet-18, SGD+momentum) — shared with scripts/profile_step.py and
     # scripts/step_time_experiment.py so the profiler measures exactly what
     # this headline reports
-    setup = make_headline_setup(per_device_batch=512)
+    setup = make_headline_setup(per_device_batch=512, quiet=args.quiet)
     mesh, ds, loader, trainer = (
         setup.mesh, setup.dataset, setup.loader, setup.trainer
     )
@@ -114,7 +119,7 @@ def main() -> None:
         )
         stream_trainer = Trainer(
             model, chunked, optax.sgd(0.05, momentum=0.9),
-            loss="cross_entropy",
+            loss="cross_entropy", quiet=args.quiet,
         )
         # Breakdown leg 1: streaming train vs the RAW H2D ceiling. The
         # ceiling is pure device_put of the same dataset bytes in
@@ -147,12 +152,10 @@ def main() -> None:
             # whole buffer would charge MBs of D2H to the H2D timing
             return float(buf[-1, -1].ravel()[-1])
 
-        def h2d_leg():
-            t0 = time.perf_counter()
+        def h2d_ceiling():
             bufs = [jax.device_put(chunk_imgs) for _ in range(n_bufs)]
             jax.block_until_ready(bufs)
             fetch_scalar(bufs[-1])
-            return time.perf_counter() - t0
 
         # warm + prime the put path (first-fetch stall lives elsewhere but
         # the first put of a new shape pays layout/allocator setup)
@@ -166,16 +169,18 @@ def main() -> None:
         # outside the bracket: epoch 0's compile takes long enough for
         # the tunnel to drift
         stream_trainer._run_epoch(0)
-        dt_before = h2d_leg()
-        stream_train_images_s = stream_trainer._run_epoch(1)[
-            "samples_per_sec"
-        ]
-        dt_after = h2d_leg()
-        dt = (dt_before + dt_after) / 2
-        # how much the tunnel moved across the bracket: ~1.0 = stable
-        # window (the fraction below is trustworthy); >>1 = the fraction
-        # is drift noise around the controlled same-process finding (~1.0)
-        h2d_drift = max(dt_before, dt_after) / min(dt_before, dt_after)
+        # obs.DriftBracket: the ceiling leg runs immediately BEFORE and
+        # AFTER the streaming epoch; ~1.0 drift = stable window (the
+        # streaming fraction below is trustworthy), >>1 = the fraction is
+        # drift noise around the controlled same-process finding (~1.0)
+        bracket = DriftBracket(
+            h2d_ceiling, payload_bytes=n_bufs * chunk_imgs.nbytes
+        ).around(
+            lambda: stream_trainer._run_epoch(1)["samples_per_sec"]
+        )
+        stream_train_images_s = bracket.result
+        dt = (bracket.before_s + bracket.after_s) / 2
+        h2d_drift = bracket.drift
         h2d_mb_s = n_bufs * chunk_imgs.nbytes / 1e6 / dt
         h2d_images_s = (
             n_bufs * chunked.steps_per_chunk * chunked.global_batch / dt
@@ -215,19 +220,18 @@ def main() -> None:
         chain_len = 256
         chain = make_step_chain(setup, chain_len, unroll=8)
 
-        state = trainer.state
-        state, losses = chain(state)  # compile
-        jax.block_until_ready(losses)
-        # min-of-2: the tunnel suffers rare multi-tens-of-seconds stalls
-        # (observed once in ~6 runs: a 2.6 s chain read as 108 s); the
-        # minimum of two closed timed regions rejects a one-off stall
-        best = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            state, losses = chain(state)
+        # obs.MinOfN(n=2): the tunnel suffers rare multi-tens-of-seconds
+        # stalls (observed once in ~6 runs: a 2.6 s chain read as 108 s);
+        # the minimum of two closed timed regions rejects a one-off stall,
+        # and the warmup run is the compile + first-fetch priming
+        holder = {"state": trainer.state}
+
+        def chain_run():
+            holder["state"], losses = chain(holder["state"])
             float(losses[-1])
-            best = min(best, time.perf_counter() - t0)
-        step_images_s = chain_len * loader.global_batch / best
+
+        step_timing = MinOfN(n=2).measure(chain_run)
+        step_images_s = chain_len * loader.global_batch / step_timing.best_s
 
         # Accuracy demonstration (BASELINE north star: "reaches reference
         # accuracy"): evaluate on the held-out test split with wrap-padding
@@ -251,9 +255,12 @@ def main() -> None:
         eval_metrics = trainer.evaluate(test_loader)
 
     per_chip = e2e / n_chips
-    print(
-        json.dumps(
-            {
+    # the schema'd envelope (obs.receipt): payload keys stay top-level so
+    # the one-JSON-line contract and its consumers are unchanged; the
+    # envelope adds schema/kind/env (git sha, jax, mesh) + the drift window
+    receipt = make_receipt(
+        "bench_headline",
+        {
                 "metric": (
                     "images/sec/chip (ResNet-18 MNIST, data-parallel train, "
                     "end-to-end incl. input pipeline)"
@@ -290,10 +297,15 @@ def main() -> None:
                     "train_step_only_images_per_sec_per_chip": round(
                         step_images_s / n_chips, 1
                     ),
+                    "train_step_only_stalled_samples": (
+                        step_timing.n_stalled
+                    ),
                 },
-            }
-        )
+        },
+        mesh=mesh,
+        drift=bracket.to_dict(),
     )
+    print(json.dumps(receipt))
 
 
 if __name__ == "__main__":

@@ -8,6 +8,7 @@ import it below, and the engine/CLI/`--list-rules` pick it up.
 from pytorch_distributed_training_tutorials_tpu.analysis.rules import (  # noqa: F401
     host_sync,
     import_purity,
+    naive_timing,
     reference_citation,
     strategy_interface,
     traced_control_flow,

@@ -1,0 +1,186 @@
+"""naive-timing: a wall-clock region around async dispatches must end with
+a real fetch — the "async mirage" lint.
+
+XLA dispatch is asynchronous: ``t0 = time.perf_counter(); f(x);
+dt = time.perf_counter() - t0`` times the *enqueue*, not the work. Before
+the process's first D2H fetch the numbers are pure fiction (CLAUDE.md's
+async-mirage note: an apparent 778k img/s "epoch" whose device trace showed
+~7 s of real work). The repo's contract — every timed region closes with a
+deliberate device fetch (``float(x[...])`` / ``int(...)`` / ``.item()`` /
+``jax.block_until_ready`` / ``jax.device_get`` / ``np.asarray``) — lived
+only in prose until this rule.
+
+Mechanics: in files that import jax, find ``t = time.perf_counter()`` (or
+``time.time`` / ``time.monotonic``) starts and their closing reads
+(``... - t``). A region that makes calls but contains no fetch before the
+closing read is flagged. Calls to same-file helper functions whose own
+bodies fetch count as fetches (the bench.py leg-helper pattern). Regions
+with no calls at all (timer-overhead calibration) are skipped. Sibling of
+``host-sync-hazard``: that rule bans syncs *inside* traced code, this one
+demands a sync at the *boundary* of every timed region.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import Rule, register
+from pytorch_distributed_training_tutorials_tpu.analysis.rules.host_sync import SYNC_PATHS
+
+TIME_PATHS = frozenset({
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+})
+
+# Builtins whose call forces a device scalar to host when given a value.
+_FETCH_BUILTINS = frozenset({"float", "int", "bool"})
+_FETCH_METHODS = frozenset({"block_until_ready", "item", "tolist"})
+
+
+def _scope_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function/module body WITHOUT descending into nested defs
+    (their bodies run later, not inside this scope's timed regions)."""
+    body = getattr(scope, "body", [])
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_time_call(node: ast.AST, imap) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and imap.resolve(node.func) in TIME_PATHS
+    )
+
+
+def _local_fetching_functions(tree: ast.AST, imap) -> set[str]:
+    """Names of same-file functions whose bodies contain a fetch."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and _is_fetch_call(
+                sub, imap, frozenset()
+            ):
+                out.add(node.name)
+                break
+    return out
+
+
+def _is_fetch_call(node: ast.Call, imap, local_fetchers: frozenset[str] | set[str]) -> bool:
+    path = imap.resolve(node.func)
+    if path in SYNC_PATHS:
+        return True
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _FETCH_METHODS:
+        return True
+    if isinstance(node.func, ast.Name):
+        if node.func.id in _FETCH_BUILTINS and node.args:
+            return True
+        if node.func.id in local_fetchers:
+            return True
+    return False
+
+
+@register
+class NaiveTiming(Rule):
+    id = "naive-timing"
+    description = (
+        "wall-clock regions in jax-importing files must close with a real "
+        "device fetch (float()/int()/.item()/block_until_ready/device_get) "
+        "— async dispatch makes unfetched timings a mirage"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        imap = ctx.import_map
+        if not any(
+            a == "jax" or a.startswith("jax.")
+            for a in imap.aliases.values()
+        ):
+            return  # no jax, no async dispatch to mis-time
+        local_fetchers = _local_fetching_functions(ctx.tree, imap)
+
+        scopes: list[ast.AST] = [ctx.tree]
+        scopes.extend(
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+        for scope in scopes:
+            yield from self._check_scope(ctx, scope, imap, local_fetchers)
+
+    def _check_scope(self, ctx, scope, imap, local_fetchers):
+        starts: list[tuple[str, int]] = []          # (var, lineno)
+        closes: list[tuple[str, int, ast.AST]] = []  # (var, lineno, node)
+        calls: list[ast.Call] = []
+        for node in _scope_nodes(scope):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _is_time_call(node.value, imap)
+            ):
+                starts.append((node.targets[0].id, node.lineno))
+            elif (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and isinstance(node.right, ast.Name)
+                and (_is_time_call(node.left, imap)
+                     or isinstance(node.left, ast.Name))
+            ):
+                closes.append((node.right.id, node.lineno, node))
+            if isinstance(node, ast.Call) and not _is_time_call(node, imap):
+                calls.append(node)
+
+        start_vars = {v for v, _ in starts}
+        for var, start_line in starts:
+            # first closing read of THIS start: the smallest region; if it
+            # lacks a fetch the reported duration is a mirage even when a
+            # later read would be covered
+            later = [
+                c for c in closes
+                if c[0] == var and c[1] > start_line
+                # a re-assigned timer var pairs with its own later start
+                and not any(
+                    s_line > start_line and c[1] > s_line
+                    for v2, s_line in starts if v2 == var
+                )
+            ]
+            if not later:
+                continue
+            close_line, close_node = min(later, key=lambda c: c[1])[1:]
+            region_calls = [
+                c for c in calls
+                if start_line < c.lineno <= close_line
+            ]
+            if not region_calls:
+                continue  # timer-overhead calibration etc: nothing dispatched
+            if any(
+                _is_fetch_call(c, imap, local_fetchers)
+                for c in region_calls
+            ):
+                continue
+            # left side being another timer var (t1 - t0) still reads both
+            # un-synced; only flag when something was actually called
+            if (
+                isinstance(close_node.left, ast.Name)
+                and close_node.left.id not in start_vars
+            ):
+                continue  # not a timing subtraction after all
+            yield self.finding(
+                ctx, close_node,
+                f"timed region ({var} set at line {start_line}) closes "
+                "with no device fetch — async dispatch makes this a "
+                "mirage; end the region with float(...)/.item()/"
+                "jax.block_until_ready(...) or suppress with the reason "
+                "the region is host-only",
+            )

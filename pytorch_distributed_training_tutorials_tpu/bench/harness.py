@@ -58,6 +58,9 @@ def slope_time(run, *, n1: int = 5, n2: int = 20, warmup: int = 2) -> float:
 def _timed(run, k: int) -> float:
     t0 = time.perf_counter()
     run(k)
+    # graftcheck: disable=naive-timing -- slope_time's contract (docstring
+    # above) requires the caller's run(k) to end with a real fetch; the
+    # fetch lives in the closure, invisible to static analysis
     return time.perf_counter() - t0
 
 

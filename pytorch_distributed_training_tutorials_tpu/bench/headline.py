@@ -25,10 +25,13 @@ class HeadlineSetup:
     dataset: Any
 
 
-def make_headline_setup(per_device_batch: int = 512) -> HeadlineSetup:
+def make_headline_setup(
+    per_device_batch: int = 512, quiet: bool = False
+) -> HeadlineSetup:
     """Build the headline workload: uint8-resident MNIST, bf16 cifar-stem
     ResNet-18, SGD+momentum trainer, plus a cached batch and the raw step
-    function for chain-timing legs."""
+    function for chain-timing legs. ``quiet`` silences the trainer's epoch
+    chatter (bench runs) without losing structured metrics."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -61,7 +64,7 @@ def make_headline_setup(per_device_batch: int = 512) -> HeadlineSetup:
     # was tunnel weather. BENCH_r05 carries the A/B.
     trainer = Trainer(
         model, loader, optax.sgd(0.05, momentum=0.9),
-        loss="cross_entropy", scan_unroll=8,
+        loss="cross_entropy", scan_unroll=8, quiet=quiet,
     )
     streaming = ShardedLoader(ds, per_device_batch, mesh, seed=0)
     batch = jax.block_until_ready(

@@ -195,14 +195,20 @@ def measure(args) -> dict:
     state2, losses = compiled(state)
     float(losses[-1])
 
-    samples = []
-    for _ in range(args.reps):
-        t0 = time.perf_counter()
-        state2, losses = compiled(state2)
+    # obs.MinOfN: stalls (> 5x median) stay visible in the receipt instead
+    # of silently widening the min; priming above is the warmup
+    holder = {"state": state2}
+
+    def run_chain():
+        holder["state"], losses = compiled(holder["state"])
         float(losses[-1])  # close the region with a real fetch
-        samples.append(time.perf_counter() - t0)
-    wall = min(samples)
-    step_s = wall / args.steps
+
+    from pytorch_distributed_training_tutorials_tpu.obs import MinOfN
+
+    timing = MinOfN(n=args.reps, warmup=False).measure(run_chain)
+    state2 = holder["state"]
+    samples = timing.samples_s
+    step_s = timing.best_s / args.steps
 
     fused = getattr(args, "fused", False)
     out = {
@@ -222,6 +228,7 @@ def measure(args) -> dict:
         "n_params": n_params,
         "steps_chained": args.steps,
         "wall_s_samples": [round(s, 3) for s in samples],
+        "stalled_samples": timing.n_stalled,
         "step_ms": round(step_s * 1e3, 2),
         "tokens_per_s": round(tokens_per_step / step_s),
         "model_tflops_per_step": round(model_flops / 1e12, 3),
@@ -236,6 +243,7 @@ def measure(args) -> dict:
     if args.trace:
         import shutil
 
+        from pytorch_distributed_training_tutorials_tpu.obs import StepReport
         from pytorch_distributed_training_tutorials_tpu.utils import profiling
 
         logdir = "/tmp/jax-trace-lm"
@@ -243,20 +251,18 @@ def measure(args) -> dict:
         with profiling.trace(logdir):
             state2, losses = compiled(state2)
             float(losses[-1])
-        durations = profiling.device_op_durations(logdir)
-        leaf_us = sum(
-            v
-            for k, v in durations.items()
-            if not (
-                k.startswith("jit_") or k.startswith("while") or k.isdigit()
-            )
+        # HLO-verified classification (obs.trace): leaf/wrapper split plus
+        # the where-did-the-step-go categories, not just a total
+        report = StepReport.from_trace(
+            logdir, hlo=compiled.as_text(), steps=args.steps
         )
-        dev_step_s = leaf_us / 1e6 / args.steps
+        dev_step_s = report.step_us / 1e6
         out["trace_step_ms"] = round(dev_step_s * 1e3, 2)
         out["trace_mfu"] = round(model_flops / dev_step_s / PEAK_BF16, 4)
         out["trace_hw_util"] = round(
             executed_flops / dev_step_s / PEAK_BF16, 4
         )
+        out["trace_report"] = report.to_dict()
     return out
 
 
@@ -307,11 +313,11 @@ def main() -> None:
         r = {"baseline": measure(base), "fused": measure(args)}
     else:
         r = measure(args)
+    from pytorch_distributed_training_tutorials_tpu.obs import make_receipt, write_receipt
+
+    r = make_receipt("lm_headline", r)
     print(json.dumps(r))
-    if args.json:
-        with open(args.json, "w") as f:
-            json.dump(r, f, indent=2)
-            f.write("\n")
+    write_receipt(args.json, r)
 
 
 if __name__ == "__main__":

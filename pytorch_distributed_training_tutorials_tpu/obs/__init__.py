@@ -1,0 +1,46 @@
+"""obs: the observability layer — telemetry, trace reports, honest timing.
+
+The reference tutorial's observability is one rank-tagged print
+(ddp_gpus.py:44); this repo's replacement grew as scattered scripts plus
+CLAUDE.md prose. ``obs`` is that lore as library code, in four pillars:
+
+- :mod:`.metrics` — :class:`MetricsLogger`: typed step/epoch events, ring
+  buffer + JSONL, process-0 gated, no per-step host sync;
+- :mod:`.trace` — :class:`StepReport`: trace-classified "where did the
+  step go" breakdowns (the PROFILE_r04 analysis as one call), fusion
+  classes HLO-verified so the ``convert_reduce_fusion`` misread cannot
+  recur;
+- :mod:`.timing` — :class:`MinOfN` (stall flagging), :class:`DriftBracket`
+  (the ``h2d_window_drift`` pattern), :func:`launch_overhead_fit`
+  (``wall = fixed + per_op * len``);
+- :mod:`.receipt` — the single schema'd envelope every number-producing
+  entry point writes through (git sha, jax version, mesh, drift window).
+
+``python -m pytorch_distributed_training_tutorials_tpu.obs --selftest`` smoke-runs all four on a
+tiny CPU-mesh workload.
+"""
+
+from pytorch_distributed_training_tutorials_tpu.obs.metrics import (  # noqa: F401
+    MetricsLogger,
+)
+from pytorch_distributed_training_tutorials_tpu.obs.trace import (  # noqa: F401
+    StepReport,
+    classify_hlo,
+)
+from pytorch_distributed_training_tutorials_tpu.obs.timing import (  # noqa: F401
+    BracketResult,
+    DriftBracket,
+    LaunchFit,
+    MinOfN,
+    TimingResult,
+    launch_overhead_fit,
+)
+from pytorch_distributed_training_tutorials_tpu.obs.receipt import (  # noqa: F401
+    KINDS,
+    SCHEMA,
+    environment_stamp,
+    load_receipt,
+    make_receipt,
+    validate_receipt,
+    write_receipt,
+)

@@ -1,0 +1,159 @@
+"""``python -m pytorch_distributed_training_tutorials_tpu.obs --selftest``: end-to-end smoke of the
+observability layer on a tiny workload.
+
+Exercises all four pillars against whatever backend is available (the
+tier-1 test runs it on the forced 8-device CPU mesh): trains a few steps
+with a JSONL-sinked :class:`MetricsLogger`, captures a real profiler trace
+of a jitted step chain, classifies it with :class:`StepReport` (HLO-
+verified), and emits an ``obs_selftest`` receipt through the schema'd
+writer. Prints exactly one JSON line on stdout and exits non-zero on any
+validation failure — a living receipt that the pipeline works.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+
+def selftest(json_path: str | None = None) -> dict:
+    import jax
+    import optax
+
+    from pytorch_distributed_training_tutorials_tpu.data import ShardedLoader, synthetic_regression
+    from pytorch_distributed_training_tutorials_tpu.models import LinearRegressor
+    from pytorch_distributed_training_tutorials_tpu.obs import (
+        MetricsLogger,
+        MinOfN,
+        StepReport,
+        make_receipt,
+        validate_receipt,
+    )
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+    from pytorch_distributed_training_tutorials_tpu.train import Trainer
+    from pytorch_distributed_training_tutorials_tpu.utils import profiling
+
+    problems: list[str] = []
+    workdir = tempfile.mkdtemp(prefix="obs-selftest-")
+    jsonl_path = os.path.join(workdir, "metrics.jsonl")
+
+    # pillar 1: metrics through a quiet, JSONL-sinked logger
+    mesh = create_mesh({"data": jax.device_count()})
+    loader = ShardedLoader(
+        synthetic_regression(size=256, in_dim=8, out_dim=1), 8, mesh
+    )
+    metrics = MetricsLogger(jsonl_path=jsonl_path, quiet=True)
+    trainer = Trainer(
+        LinearRegressor(in_dim=8), loader, optax.sgd(1e-2), loss="mse",
+        metrics=metrics, log_every=2,
+    )
+    trainer.train(2)
+    metrics.close()
+    if not metrics.epoch_events():
+        problems.append("no epoch events recorded")
+    if not metrics.step_events():
+        problems.append("no step events recorded")
+    with open(jsonl_path) as f:
+        jsonl_lines = [json.loads(line) for line in f if line.strip()]
+    if len(jsonl_lines) != len(metrics.events):
+        problems.append(
+            f"jsonl sink ({len(jsonl_lines)}) != ring buffer "
+            f"({len(metrics.events)})"
+        )
+
+    # pillar 3: MinOfN on a fetch-closed chain (warmup primes first fetch)
+    steps = 4
+    batch = next(iter(loader))
+
+    def chain(s, b):
+        return jax.lax.scan(
+            lambda st, _: (trainer.train_step(st, b)[0], None),
+            s, None, length=steps,
+        )[0]
+
+    compiled = jax.jit(chain).lower(trainer.state, batch).compile()
+    timing = MinOfN(n=3).measure(
+        lambda: jax.block_until_ready(compiled(trainer.state, batch))
+    )
+    if timing.best_s <= 0:
+        problems.append("MinOfN produced a non-positive sample")
+
+    # pillar 2: a real trace, classified against the compiled HLO
+    logdir = os.path.join(workdir, "trace")
+    with profiling.trace(logdir):
+        jax.block_until_ready(compiled(trainer.state, batch))
+    report = StepReport.from_trace(
+        logdir, hlo=compiled.as_text(), steps=steps
+    )
+    if report.total_us <= 0:
+        problems.append("trace captured no device time")
+    if report.unclassified_fraction > 0.10:
+        problems.append(
+            f"{100 * report.unclassified_fraction:.1f}% of device time "
+            "unclassified (>10%)"
+        )
+
+    # pillar 4: the schema'd receipt, validated before it is reported
+    receipt = make_receipt(
+        "obs_selftest",
+        {
+            "last_epoch": metrics.last_epoch,
+            "n_events": len(metrics.events),
+            "timing": timing.to_dict(),
+            "step_report": report.to_dict(),
+            "problems": problems,
+            "ok": not problems,
+        },
+        mesh=mesh,
+    )
+    problems.extend(validate_receipt(receipt, kind="obs_selftest"))
+    receipt["ok"] = not problems
+    receipt["problems"] = problems
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(receipt, f, indent=2)
+            f.write("\n")
+    shutil.rmtree(workdir, ignore_errors=True)
+    return receipt
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m pytorch_distributed_training_tutorials_tpu.obs")
+    parser.add_argument(
+        "--selftest", action="store_true",
+        help="run the end-to-end observability smoke test",
+    )
+    parser.add_argument(
+        "--json", default=None, help="also write the receipt to this path"
+    )
+    args = parser.parse_args(argv)
+    if not args.selftest:
+        parser.print_help()
+        return 2
+    # ad-hoc CPU runs need the config update as well as the env var
+    # (sitecustomize pre-imports jax._src — see CLAUDE.md)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""
+        ):
+            # a bare 1-device XLA:CPU run executes ops inline (no tf_XLA
+            # executor threads), so the profiler trace carries no device
+            # lanes; the forced mesh is also what tier-1 exercises
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    receipt = selftest(args.json)
+    print(json.dumps(receipt))
+    return 0 if receipt["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,7 +42,8 @@ from pytorch_distributed_training_tutorials_tpu.ops.fused_loss import (
 from pytorch_distributed_training_tutorials_tpu.parallel.data_parallel import (
     DataParallel,
 )
-from pytorch_distributed_training_tutorials_tpu.utils.logging import epoch_line, log0
+from pytorch_distributed_training_tutorials_tpu.obs.metrics import MetricsLogger
+from pytorch_distributed_training_tutorials_tpu.utils.logging import epoch_line
 
 
 class TrainState(struct.PyTreeNode):
@@ -464,6 +465,10 @@ class Trainer:
         defer_host_fetch: bool = False,
         scan_unroll: int = 1,
         pregather: bool = False,
+        metrics: MetricsLogger | None = None,
+        quiet: bool = False,
+        on_step=None,
+        on_epoch=None,
     ):
         self.model = model
         self.loader = train_loader
@@ -541,6 +546,20 @@ class Trainer:
         # resulting wall-clock is NOT trustworthy without a terminal fetch
         # — see the CLAUDE.md async-mirage note.)
         self.defer_host_fetch = defer_host_fetch
+        # metrics: every number and console line the loop produces flows
+        # through one MetricsLogger (obs/metrics.py) — the verbose step
+        # print and the structured record are the same fetch, and the
+        # logger honors defer_host_fetch at epoch boundaries. ``quiet``
+        # silences console output (bench runs) without losing events.
+        self.metrics = metrics if metrics is not None else MetricsLogger(
+            quiet=quiet, defer_host_fetch=defer_host_fetch
+        )
+        # host-side hook points, called OUTSIDE traced code (graftcheck-
+        # clean by construction): on_step(step, loss_device_scalar) after
+        # each dispatched step/chunk, on_epoch(metrics_dict) after each
+        # epoch. Hooks must not fetch if they care about throughput.
+        self.on_step = on_step
+        self.on_epoch = on_epoch
         self.last_epoch_losses = None  # device array, chunked path only
         self.loss_name = loss
         self.aux_loss_weight = aux_loss_weight
@@ -563,11 +582,9 @@ class Trainer:
             if dt > 0
             else float("inf"),
         }
-        log0(
-            f"  epoch {epoch}: loss {m['loss']:.4f} | "
-            f"{m['steps_per_sec']:.1f} steps/s | "
-            f"{m['samples_per_sec']:.0f} samples/s"
-        )
+        self.metrics.log_epoch(m)
+        if self.on_epoch is not None:
+            self.on_epoch(m)
         return m
 
     def _run_epoch_scanned(self, epoch: int) -> dict:
@@ -582,7 +599,7 @@ class Trainer:
                 unroll=self.scan_unroll,
                 pregather=self.pregather,
             )
-        log0(
+        self.metrics.say(
             epoch_line(
                 self.strategy.num_devices, epoch,
                 loader.per_device_batch, len(loader),
@@ -636,7 +653,7 @@ class Trainer:
         dt = time.perf_counter() - t0
         for e in range(n_epochs):
             epoch_losses = losses[e * steps : (e + 1) * steps]
-            log0(
+            self.metrics.say(
                 f"  epoch {first_epoch + e}: loss "
                 f"{float(epoch_losses[-1]):.4f} (fused scan)"
             )
@@ -660,7 +677,7 @@ class Trainer:
         chunk length."""
         loader = self.loader
         loader.set_epoch(epoch)
-        log0(
+        self.metrics.say(
             epoch_line(
                 self.strategy.num_devices, epoch,
                 loader.per_device_batch, len(loader),
@@ -695,8 +712,10 @@ class Trainer:
                 # per-chunk granularity (a chunk is one compiled launch;
                 # per-step logs would force a D2H sync into the scan) —
                 # costs one loss fetch, so only when log_every opted in
-                log0(f"  step {steps}: loss {float(chunk_losses[-1]):.4f}")
+                self.metrics.log_step(steps, chunk_losses[-1], verbose=True)
                 next_log = steps + self.log_every
+            if self.on_step is not None:
+                self.on_step(steps, chunk_losses[-1])
         self.last_epoch_losses = losses[-1] if losses else None
         if self.defer_host_fetch:
             # completion sync only — no D2H (see defer_host_fetch in
@@ -728,7 +747,7 @@ class Trainer:
             # microbatching lives inside make_train_step)
             return self._run_epoch_chunked(epoch)
         self.loader.set_epoch(epoch)  # reference ddp_gpus.py:45
-        log0(
+        self.metrics.say(
             epoch_line(
                 self.strategy.num_devices,
                 epoch,
@@ -745,8 +764,15 @@ class Trainer:
             self.state, metrics = self.train_step(self.state, batch)
             loss = metrics["loss"]
             steps += 1
-            if self.log_every and steps % self.log_every == 0:
-                log0(f"  step {steps}: loss {float(loss):.4f}")
+            # device scalar retained un-fetched; the verbose line is the
+            # log_every opt-in and costs its one historical loss fetch
+            self.metrics.log_step(
+                steps, loss,
+                verbose=bool(self.log_every)
+                and steps % self.log_every == 0,
+            )
+            if self.on_step is not None:
+                self.on_step(steps, loss)
         jax.block_until_ready(self.state.params)
         dt = time.perf_counter() - t0
         return self._epoch_metrics(epoch, loss, steps, dt)
@@ -760,7 +786,7 @@ class Trainer:
         section 5.3/5.4; this closes that gap).
         """
         if self.epoch >= max_epochs:
-            log0(
+            self.metrics.say(
                 f"train: already at epoch {self.epoch} >= {max_epochs}, "
                 "nothing to run"
             )

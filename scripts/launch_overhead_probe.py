@@ -18,8 +18,10 @@ separates the terms. Measured on the tunneled v5e (2026-07-31):
 
 Consequence: serving-decode latency on this runtime is launch/stall-bound,
 not kernel-bound, and *bigger timed regions* (longer chains, fused decode
-loops) are the honest way to measure it. ``min_over`` runs below reject
-stalls; the linear fit reports both terms.
+loops) are the honest way to measure it. The measurement machinery lives
+in ``obs.timing`` (:class:`MinOfN` rejects stalls,
+:func:`launch_overhead_fit` is the two-length fit); each probe prints an
+``obs.receipt``-schema'd JSON line.
 
 Usage: python scripts/launch_overhead_probe.py
 """
@@ -29,24 +31,15 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def _min_over(f, n: int = 4) -> float:
-    best = float("inf")
-    for _ in range(n):
-        t0 = time.perf_counter()
-        f()
-        best = min(best, time.perf_counter() - t0)
-    return best
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from pytorch_distributed_training_tutorials_tpu.obs import MinOfN, launch_overhead_fit, make_receipt
     from pytorch_distributed_training_tutorials_tpu.ops.quant import (
         int8_matmul,
         quantize_int8,
@@ -59,19 +52,20 @@ def main() -> None:
     wb = jax.device_put(jax.random.normal(kw, (k, n), jnp.bfloat16))
     wq = jax.device_put(quantize_int8(jax.random.normal(kw, (k, n), jnp.float32)))
 
-    def chain(body, length):
-        @jax.jit
-        def run(x0):
-            return jax.lax.scan(body, x0, None, length=length)
+    def make_time_chain(body):
+        def time_chain(length: int) -> float:
+            @jax.jit
+            def run(x0):
+                return jax.lax.scan(body, x0, None, length=length)
 
-        _, ys = run(x)
-        float(ys[-1])  # compile + prime the first fetch
+            def timed():
+                _, ys = run(x)
+                float(ys[-1])  # the honest close: one real fetch
 
-        def timed():
-            _, ys = run(x)
-            float(ys[-1])
+            # MinOfN's warmup run compiles + primes the first fetch
+            return MinOfN(n=4).measure(timed).best_s
 
-        return _min_over(timed)
+        return time_chain
 
     def bf16_body(c, _):
         y = jnp.dot(c.astype(jnp.bfloat16), wb).astype(jnp.float32)
@@ -81,23 +75,22 @@ def main() -> None:
         y = int8_matmul(c, wq)
         return c + y[:, :1] * 1e-9, y[0, 0]
 
-    lens = (64, 1024)
     for name, body in [("bf16_dot", bf16_body), ("pallas_int8", int8_body)]:
-        t_short = chain(body, lens[0])
-        t_long = chain(body, lens[1])
-        per_op_us = (t_long - t_short) / (lens[1] - lens[0]) * 1e6
-        fixed_ms = (t_short - per_op_us * 1e-6 * lens[0]) * 1e3
-        print(json.dumps({
+        fit = launch_overhead_fit(make_time_chain(body), lens=(64, 1024))
+        receipt = make_receipt("launch_probe", {
             "body": name,
             "shape": [m, k, n],
-            "wall_ms": {str(lens[0]): round(t_short * 1e3, 1),
-                        str(lens[1]): round(t_long * 1e3, 1)},
-            "per_op_us": round(per_op_us, 1),
-            "fixed_launch_ms": round(fixed_ms, 1),
+            "wall_ms": {
+                str(ln): round(w * 1e3, 1)
+                for ln, w in zip(fit.lens, fit.wall_s)
+            },
+            "per_op_us": round(fit.per_op_us, 1),
+            "fixed_launch_ms": round(fit.fixed_ms, 1),
             "naive_32chain_would_report_ms_per_op": round(
-                (fixed_ms / 32) + per_op_us / 1e3, 2
+                fit.naive_per_op_us(32) / 1e3, 2
             ),
-        }))
+        })
+        print(json.dumps(receipt))
         sys.stdout.flush()
 
 

@@ -2,8 +2,10 @@
 
 This script answers "where does the decode step actually spend device
 time" the same way PROFILE_r04.md did for the train step: capture a
-jax.profiler trace of one compiled generate() call and aggregate
-on-device op durations. Round-4 finding (DECODE_r04.md): the 1.2B decode
+jax.profiler trace of one compiled generate() call and classify
+on-device op durations with ``obs.StepReport`` (the same
+fusion-body-aware classifier every other trace consumer uses — no local
+name heuristics). Round-4 finding (DECODE_r04.md): the 1.2B decode
 executes ~3.6 ms/step on device; the original 2.7 tok/s receipt was
 numpy-leaf re-upload (fixed by utils.tree.device_materialize), not
 device time — this trace was the evidence (device busy 0.08 s inside a
@@ -17,7 +19,6 @@ Requires the cached 1b checkpoint (run examples/serve_llm_int8.py
 
 from __future__ import annotations
 
-import collections
 import dataclasses
 import json
 import os
@@ -38,6 +39,10 @@ def main() -> None:
     from pytorch_distributed_training_tutorials_tpu.models.generate import generate
     from pytorch_distributed_training_tutorials_tpu.models.transformer import (
         load_quantized_lm,
+    )
+    from pytorch_distributed_training_tutorials_tpu.obs import (
+        StepReport,
+        make_receipt,
     )
     from pytorch_distributed_training_tutorials_tpu.utils import profiling
 
@@ -71,43 +76,32 @@ def main() -> None:
         out = generate(lm, params, prompt, new_tokens)
         int(out[0, -1])
 
-    durs = profiling.device_op_durations(logdir)
-    total_ms = sum(durs.values()) / 1e3
-    # drop the jit_run wrapper (it double-counts its children)
-    inner = {k: v for k, v in durs.items() if not k.startswith("jit_")}
-    inner_ms = sum(inner.values()) / 1e3
-
-    def classify(name: str) -> str:
-        n = name.lower()
-        if "int8" in n or "pallas" in n or "matmul_kernel" in n:
-            return "int8 matmul kernel"
-        if "dot" in n or "conv" in n:
-            return "other matmul/dot"
-        if "dynamic-update" in n or "dynamic_update" in n:
-            return "cache update"
-        if "copy" in n or "bitcast" in n or "transpose" in n:
-            return "copy/layout"
-        if "fusion" in n:
-            return "fusion (elementwise/other)"
-        if "reduce" in n:
-            return "reduce"
-        return "other"
-
-    by_class: dict[str, float] = collections.defaultdict(float)
-    for k, v in inner.items():
-        by_class[classify(k)] += v / 1e3
+    # wrapper exclusion + fusion classification live in obs.trace now:
+    # wrappers (jit_*, while, ThunkExecutor::*) are split out so they
+    # can't double-count their children, and a pallas int8 kernel shows
+    # up as matmul, not "other"
     steps = max(new_tokens - 1, 1)
-    print(json.dumps({
+    report = StepReport.from_trace(logdir, steps=steps)
+    pallas_us = sum(
+        us for op, us, _ in report.ops
+        if "int8" in op or "pallas" in op or "matmul_kernel" in op
+    )
+    receipt = make_receipt("profile_decode", {
         "new_tokens": new_tokens,
-        "device_ms_total_incl_wrappers": round(total_ms, 1),
-        "device_ms_ops": round(inner_ms, 1),
-        "by_class_ms": {k: round(v, 1) for k, v in sorted(
-            by_class.items(), key=lambda kv: -kv[1])},
-        "per_decode_step_ms_ops": round(inner_ms / steps, 1),
-    }))
+        "device_ms_total_incl_wrappers":
+            round((report.total_us + report.wrapper_us) / 1e3, 1),
+        "device_ms_ops": round(report.total_us / 1e3, 1),
+        "by_class_ms": {
+            k: round(v / 1e3, 1) for k, v in sorted(
+                report.by_category.items(), key=lambda kv: -kv[1])},
+        "pallas_int8_kernel_ms": round(pallas_us / 1e3, 1),
+        "per_decode_step_ms_ops": round(report.step_us / 1e3, 1),
+        "unclassified_fraction": round(report.unclassified_fraction, 3),
+    })
+    print(json.dumps(receipt))
     print("\ntop 40 ops (ms):")
-    for k, v in list(inner.items())[:40]:
-        print(f"  {v/1e3:10.2f}  {k[:110]}")
+    for op, us, cls in sorted(report.ops, key=lambda r: -r[1])[:40]:
+        print(f"  {us/1e3:10.2f}  [{cls}] {op[:100]}")
 
 
 if __name__ == "__main__":

@@ -19,74 +19,11 @@ Run on the real chip:  python scripts/profile_step.py
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CHAIN_LEN = 64
-
-
-def parse_hlo(hlo: str):
-    """Map HLO instruction name -> (classification, source op_name).
-
-    Classification rules, applied to the *called fused computation* for
-    fusions (the instruction line's own name is marketing, not truth):
-    convolution > reduce > elementwise; non-fusion instructions classify by
-    their opcode.
-    """
-    # computation name -> body text
-    comps: dict[str, str] = {}
-    cur = None
-    body: list[str] = []
-    for line in hlo.splitlines():
-        if cur is None and line.startswith("%") and line.rstrip().endswith("{"):
-            cur = line.split()[0].lstrip("%")
-            body = []
-        elif cur is not None and line.startswith("}"):
-            comps[cur] = "\n".join(body)
-            cur = None
-        elif cur is not None:
-            body.append(line)
-    info: dict[str, tuple[str, str]] = {}
-    # "%name = <type> opcode(operands)...": the type may be a tuple full of
-    # layout parens like (f32[64]{0:T(128)S(1)}, ...), so the opcode is the
-    # first *lowercase* word directly preceding a "(" after the type
-    inst_re = re.compile(
-        r"^\s+%([\w\.\-]+)\s*=\s+(?:\([^=]*?\)|[^\s(]+)\s+([a-z][\w\-]*)\("
-    )
-    for line in hlo.splitlines():
-        m = inst_re.match(line)
-        if not m:
-            continue
-        name, opcode = m.group(1), m.group(2)
-        call = re.search(r"calls=%([\w\.\-]+)", line)
-        meta = re.search(r'op_name="([^"]+)"', line)
-        op_name = meta.group(1) if meta else ""
-        if opcode == "fusion" and call:
-            cbody = comps.get(call.group(1), "")
-            if "convolution(" in cbody:
-                cls = "convolution"
-            elif "dot(" in cbody:
-                cls = "matmul"
-            elif "reduce(" in cbody or "reduce-window(" in cbody:
-                cls = "reduce"
-            else:
-                cls = "elementwise"
-        elif opcode == "convolution":
-            cls = "convolution"
-        elif opcode == "dot":
-            cls = "matmul"
-        elif opcode in ("reduce", "reduce-window"):
-            cls = "reduce"
-        elif opcode in ("copy", "copy-start", "copy-done", "transpose", "bitcast"):
-            cls = "copy/layout"
-        elif opcode in ("all-reduce", "all-gather", "reduce-scatter", "collective-permute"):
-            cls = "collective"
-        else:
-            cls = "elementwise"
-        info[name] = (cls, op_name)
-    return info
 
 
 def source_group(op_name: str) -> str:
@@ -114,6 +51,12 @@ def main() -> None:
         make_headline_setup,
         make_step_chain,
     )
+    from pytorch_distributed_training_tutorials_tpu.obs import (
+        StepReport,
+        classify_hlo,
+        make_receipt,
+        write_receipt,
+    )
     from pytorch_distributed_training_tutorials_tpu.utils import profiling
 
     # the exact headline workload (shared with bench.py's step leg)
@@ -126,7 +69,10 @@ def main() -> None:
     chain = make_step_chain(setup, CHAIN_LEN, unroll=1)
 
     compiled = chain.lower(trainer.state).compile()
-    hlo_info = parse_hlo(compiled.as_text())
+    # classification lives in obs.trace now (classify_hlo /
+    # StepReport.from_trace): fusions resolve through their called fused
+    # computation, never their display name — the convert_reduce_fusion fix
+    hlo_info = classify_hlo(compiled.as_text())
     # exact FLOPs from XLA's own cost model (one un-scanned step)
     step_cost = (
         jax.jit(step_fn).lower(trainer.state, batch).compile().cost_analysis()
@@ -143,31 +89,20 @@ def main() -> None:
         state, losses = compiled(state)
         float(losses[-1])
 
-    durations = profiling.device_op_durations(logdir)
-    # Wrapper events nest: the module-level event ("0"), the scan loop
-    # ("while.*"), and jit_* regions each contain the leaf ops — counting
-    # them alongside the leaves double-counts the step 3x. Keep leaves only.
-    leaf = {
-        k: v
-        for k, v in durations.items()
-        if not (k.startswith("jit_") or k.startswith("while") or k.isdigit())
-    }
-    total_us = sum(leaf.values())
-    by_cls: dict[str, float] = {}
+    report = StepReport.from_trace(
+        logdir, hlo=compiled.as_text(), steps=CHAIN_LEN
+    )
+    total_us = report.total_us
+    by_cls = report.by_category
     by_src: dict[str, float] = {}
     rows = []
-    for op, us in leaf.items():
-        cls, op_name = hlo_info.get(op, (None, ""))
-        if cls is None:
-            # trace events not in the entry computation (e.g. sub-fusion
-            # lanes) — classify by name, conservatively
-            cls = "copy/layout" if "copy" in op else "elementwise"
-        by_cls[cls] = by_cls.get(cls, 0.0) + us
+    for op, us, cls in report.ops:
+        op_name = hlo_info.get(op, ("", ""))[1]
         by_src.setdefault(source_group(op_name), 0.0)
         by_src[source_group(op_name)] += us
         rows.append((op, us, cls, op_name))
 
-    per_step_us = total_us / CHAIN_LEN
+    per_step_us = report.step_us
     img_s = per_device_batch * 1e6 / per_step_us
     peak_tf = 197e12  # v5e bf16 peak
     mfu = img_s * flops_per_img / peak_tf
@@ -287,11 +222,27 @@ def main() -> None:
         )
     lines.append("")
     out = "\n".join(lines) + "\n"
-    with open(
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     "PROFILE_r04.md"), "w"
-    ) as f:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo_root, "PROFILE_r04.md"), "w") as f:
         f.write(out)
+    # machine-readable twin of the markdown narrative, schema'd (obs.receipt)
+    write_receipt(
+        os.path.join(repo_root, "PROFILE_step.json"),
+        make_receipt("profile_step", {
+            "workload": "resnet18-bs512-bf16-mnist-train-step",
+            "chain_len": CHAIN_LEN,
+            "per_step_ms": round(per_step_us / 1e3, 3),
+            "images_per_sec": round(img_s, 1),
+            "mfu": round(mfu, 4),
+            "flops_per_image": round(flops_per_img, 1),
+            "step_report": report.to_dict(),
+            "by_source": {
+                k: round(v, 1) for k, v in sorted(
+                    by_src.items(), key=lambda kv: -kv[1]
+                )
+            },
+        }),
+    )
     print(out)
 
 

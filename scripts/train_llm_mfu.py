@@ -142,9 +142,14 @@ def main() -> None:
         print(json.dumps(r, indent=2))
 
     if args.json:
-        with open(args.json, "w") as f:
-            json.dump(results, f, indent=2)
-            f.write("\n")
+        from pytorch_distributed_training_tutorials_tpu.obs import make_receipt, write_receipt
+
+        # the schema'd envelope (obs.receipt): git sha / jax / backend ride
+        # with the sweep rows, so a receipt can't outlive knowing what
+        # produced it
+        write_receipt(
+            args.json, make_receipt("llm_mfu_sweep", {"results": results})
+        )
         print(f"results -> {args.json}")
 
 

@@ -369,6 +369,88 @@ def test_reference_citation_pytest_node_ids_are_not_citations(tmp_path):
     assert not hits(check(src, config=cfg), "reference-citation")
 
 
+# ------------------------------------------------------------------ naive-timing
+
+def test_naive_timing_fires_on_unfetched_region():
+    # the async mirage: times the enqueue, not the work
+    src = """
+        import time
+        import jax
+
+        def leg(fn, x):
+            t0 = time.perf_counter()
+            fn(x)
+            dt = time.perf_counter() - t0
+            return dt
+    """
+    found = hits(check(src), "naive-timing")
+    assert len(found) == 1 and found[0].line == 8
+    assert "no device fetch" in found[0].message
+
+
+def test_naive_timing_clean_when_region_closes_with_a_fetch():
+    src = """
+        import time
+        import jax
+
+        def leg_blocked(fn, x):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(x))
+            return time.perf_counter() - t0
+
+        def leg_float(fn, x):
+            t0 = time.time()
+            out = fn(x)
+            loss = float(out[-1])
+            return time.time() - t0, loss
+    """
+    assert not hits(check(src), "naive-timing")
+
+
+def test_naive_timing_resolves_same_file_fetching_helpers():
+    # the bench.py idiom: the fetch lives in a local helper the timed
+    # region calls
+    src = """
+        import time
+        import jax
+
+        def run_and_fetch(fn, x):
+            out = fn(x)
+            return float(out)
+
+        def leg(fn, x):
+            t0 = time.perf_counter()
+            run_and_fetch(fn, x)
+            return time.perf_counter() - t0
+    """
+    assert not hits(check(src), "naive-timing")
+
+
+def test_naive_timing_skips_files_without_jax():
+    # no jax import, no async dispatch: plain wall-clock code is fine
+    src = """
+        import time
+
+        def leg(fn, x):
+            t0 = time.perf_counter()
+            fn(x)
+            return time.perf_counter() - t0
+    """
+    assert not hits(check(src), "naive-timing")
+
+
+def test_naive_timing_skips_callless_calibration_regions():
+    src = """
+        import time
+        import jax
+
+        def timer_overhead():
+            t0 = time.perf_counter()
+            return time.perf_counter() - t0
+    """
+    assert not hits(check(src), "naive-timing")
+
+
 # ----------------------------------------------------------------- suppressions
 
 SUPPRESSED = """
