@@ -41,6 +41,11 @@ from pytorch_distributed_training_tutorials_tpu.models.utils import (  # noqa: F
 from pytorch_distributed_training_tutorials_tpu.models.generate import (  # noqa: F401
     generate,
 )
+from pytorch_distributed_training_tutorials_tpu.models.sampling import (  # noqa: F401
+    filter_logits,
+    sample_logits,
+    sample_logits_per_slot,
+)
 from pytorch_distributed_training_tutorials_tpu.models.transformer import (  # noqa: F401
     load_quantized_lm,
     quantize_lm_params,

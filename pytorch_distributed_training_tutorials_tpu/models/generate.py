@@ -30,55 +30,16 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from pytorch_distributed_training_tutorials_tpu.models.sampling import (
+    _NUCLEUS_CANDIDATES,  # noqa: F401  (re-exported: test/caller compat)
+    filter_logits,
+    sample_logits,
+)
 
-# Candidate budget for nucleus (top_p) filtering when top_k is off. The
-# nucleus cutoff only depends on the highest-probability tokens, so it is
-# computed from ``lax.top_k(logits, cap)`` instead of a full-vocabulary
-# descending sort — at a 32-50k vocab the O(V log V) sort inside the
-# per-token decode scan rivals the lm_head matmul itself. Exact whenever
-# the nucleus holds <= cap tokens (always, for practical p and peaked LM
-# distributions); a flatter-than-cap distribution degrades gracefully to
-# an implicit additional top-1024 cut.
-_NUCLEUS_CANDIDATES = 1024
-
-
-def _filter_logits(logits, top_k: int, top_p: float):
-    """Standard serving logit filters, XLA-friendly (static shapes, no
-    data-dependent control flow, no full-vocab sort — ``lax.top_k`` with
-    k << V is the TPU idiom): ``top_k`` keeps the k highest logits,
-    ``top_p`` (nucleus) keeps the smallest set of tokens whose softmax
-    mass reaches p. Disallowed tokens get -inf so ``categorical`` never
-    picks them. Both filters compose (k first, then p, the usual order);
-    when both are active one ``lax.top_k`` call feeds both, and the
-    nucleus mass is normalized over the k-filtered support (exactly what
-    softmax-after-the-k-filter yields)."""
-    v = logits.shape[-1]
-    k_active = 0 < top_k < v
-    vals = None
-    if k_active:
-        vals = jax.lax.top_k(logits, top_k)[0]  # descending
-        kth = vals[..., -1:]
-        # strict < keeps boundary ties, same as argmax keeping the first
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p < 1.0:
-        if vals is None:
-            vals = jax.lax.top_k(logits, min(v, _NUCLEUS_CANDIDATES))[0]
-        # softmax mass of each candidate under the (k-)filtered
-        # distribution; one O(V) logsumexp pass, no sort
-        z = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
-        probs = jnp.exp(vals - z)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep tokens while the mass BEFORE them is < p (the first token
-        # is always kept, matching the conventional implementation); if
-        # every candidate is kept the cutoff is the last candidate value,
-        # so tokens below the candidate set are dropped — the documented
-        # implicit top-cap degradation
-        keep = (cum - probs) < top_p
-        cutoff = jnp.min(
-            jnp.where(keep, vals, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return logits
+# The sampling pipeline moved to models/sampling.py so the continuous-
+# batching engine (serve/) shares the exact same code; the old private
+# name stays importable.
+_filter_logits = filter_logits
 
 
 @functools.lru_cache(maxsize=64)
@@ -90,16 +51,7 @@ def _compiled_generate(
     hash by structure, so this caches across calls with the same config)."""
 
     def sample(logits, key):
-        if temperature > 0:
-            key, sub = jax.random.split(key)
-            # temperature BEFORE the filters (the standard pipeline order
-            # — top_k is order-invariant but the nucleus is not: it must
-            # be taken over the temperature-sharpened distribution)
-            logits = _filter_logits(logits / temperature, top_k, top_p)
-            nxt = jax.random.categorical(sub, logits, axis=-1)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
-        return nxt.astype(jnp.int32), key
+        return sample_logits(logits, key, temperature, top_k, top_p)
 
     @jax.jit
     def run(params, tokens, key):

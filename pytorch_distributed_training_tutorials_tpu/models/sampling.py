@@ -1,0 +1,114 @@
+"""The serving sampling pipeline — ONE shared copy.
+
+Temperature scaling, top-k, and nucleus (top-p) filtering used to live
+inside :mod:`.generate`; the continuous-batching engine (:mod:`..serve`)
+needs the identical pipeline per cache slot, and two drifting copies of
+sampling semantics is how serving stacks grow subtle A/B bugs. This module
+is the single implementation both consume:
+
+- :func:`filter_logits` — the XLA-friendly top-k / nucleus filters
+  (``lax.top_k`` with k << V, never a full-vocabulary sort);
+- :func:`sample_logits` — one sampling decision for a whole batch sharing
+  ONE PRNG key (the :func:`..models.generate.generate` contract);
+- :func:`sample_logits_per_slot` — the same decision vmapped over per-slot
+  keys, so each serving request's draw stream depends only on its own seed
+  and emitted-token count, never on which other requests happen to share
+  the decode batch.
+
+Greedy (``temperature == 0``) is ``argmax`` and ignores filters and keys in
+all variants — the path the token-exactness guarantees ride on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Candidate budget for nucleus (top_p) filtering when top_k is off. The
+# nucleus cutoff only depends on the highest-probability tokens, so it is
+# computed from ``lax.top_k(logits, cap)`` instead of a full-vocabulary
+# descending sort — at a 32-50k vocab the O(V log V) sort inside the
+# per-token decode scan rivals the lm_head matmul itself. Exact whenever
+# the nucleus holds <= cap tokens (always, for practical p and peaked LM
+# distributions); a flatter-than-cap distribution degrades gracefully to
+# an implicit additional top-1024 cut.
+_NUCLEUS_CANDIDATES = 1024
+
+
+def filter_logits(logits, top_k: int, top_p: float):
+    """Standard serving logit filters, XLA-friendly (static shapes, no
+    data-dependent control flow, no full-vocab sort — ``lax.top_k`` with
+    k << V is the TPU idiom): ``top_k`` keeps the k highest logits,
+    ``top_p`` (nucleus) keeps the smallest set of tokens whose softmax
+    mass reaches p. Disallowed tokens get -inf so ``categorical`` never
+    picks them. Both filters compose (k first, then p, the usual order);
+    when both are active one ``lax.top_k`` call feeds both, and the
+    nucleus mass is normalized over the k-filtered support (exactly what
+    softmax-after-the-k-filter yields)."""
+    v = logits.shape[-1]
+    k_active = 0 < top_k < v
+    vals = None
+    if k_active:
+        vals = jax.lax.top_k(logits, top_k)[0]  # descending
+        kth = vals[..., -1:]
+        # strict < keeps boundary ties, same as argmax keeping the first
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        if vals is None:
+            vals = jax.lax.top_k(logits, min(v, _NUCLEUS_CANDIDATES))[0]
+        # softmax mass of each candidate under the (k-)filtered
+        # distribution; one O(V) logsumexp pass, no sort
+        z = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+        probs = jnp.exp(vals - z)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the mass BEFORE them is < p (the first token
+        # is always kept, matching the conventional implementation); if
+        # every candidate is kept the cutoff is the last candidate value,
+        # so tokens below the candidate set are dropped — the documented
+        # implicit top-cap degradation
+        keep = (cum - probs) < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, vals, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
+def sample_logits(
+    logits, key, temperature: float, top_k: int = 0, top_p: float = 1.0
+):
+    """One next-token decision over ``(..., V)`` float32 logits.
+
+    Greedy argmax when ``temperature == 0`` (key untouched); otherwise
+    temperature BEFORE the filters (the standard pipeline order — top_k is
+    order-invariant but the nucleus is not: it must be taken over the
+    temperature-sharpened distribution), then one ``categorical`` draw for
+    the whole batch from a single split of ``key``. Returns ``(tokens
+    int32, carried key)``."""
+    if temperature > 0:
+        key, sub = jax.random.split(key)
+        logits = filter_logits(logits / temperature, top_k, top_p)
+        nxt = jax.random.categorical(sub, logits, axis=-1)
+    else:
+        nxt = jnp.argmax(logits, axis=-1)
+    return nxt.astype(jnp.int32), key
+
+
+def sample_logits_per_slot(
+    logits, keys, temperature: float, top_k: int = 0, top_p: float = 1.0
+):
+    """:func:`sample_logits` with per-row PRNG streams: ``logits`` is
+    ``(S, V)``, ``keys`` is ``(S, 2)`` uint32 — slot s draws from its own
+    key, split exactly like the shared-key variant (carry = row 0 of the
+    split, draw = row 1), so a request's sampled tokens are a function of
+    its seed and its position in its own stream only. Co-scheduling,
+    slot assignment, and chain boundaries cannot change them. Returns
+    ``(tokens (S,) int32, carried keys (S, 2))``."""
+    if temperature > 0:
+        split = jax.vmap(jax.random.split)(keys)  # (S, 2, 2)
+        keys, subs = split[:, 0], split[:, 1]
+        filt = filter_logits(logits / temperature, top_k, top_p)
+        nxt = jax.vmap(jax.random.categorical)(subs, filt)
+    else:
+        nxt = jnp.argmax(logits, axis=-1)
+    return nxt.astype(jnp.int32), keys

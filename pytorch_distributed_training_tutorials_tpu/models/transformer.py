@@ -137,15 +137,23 @@ class RMSNorm(nn.Module):
 def apply_rope(x: jax.Array, theta: float, offset=0) -> jax.Array:
     """Rotary position embedding over the last axis. ``x``: (B, S, H, D).
 
-    ``offset`` shifts the positions (scalar, may be traced) — incremental
-    decoding applies rope at the token's *global* position while S == 1.
+    ``offset`` shifts the positions (may be traced) — incremental decoding
+    applies rope at the token's *global* position while S == 1. A scalar
+    offset shifts every row identically (generate()); a ``(B,)`` vector
+    gives each batch row its OWN position, the slot-indexed decode mode
+    (serve/) where co-batched requests sit at different depths.
     """
     seq_len, half = x.shape[1], x.shape[-1] // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    pos = offset + jnp.arange(seq_len, dtype=jnp.float32)
-    angles = pos[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]  # (1, S, 1, half)
-    sin = jnp.sin(angles)[None, :, None, :]
+    off = jnp.asarray(offset, jnp.float32)
+    pos = off[..., None] + jnp.arange(seq_len, dtype=jnp.float32)
+    angles = pos[..., :, None] * freqs  # (S, half) or (B, S, half)
+    if off.ndim == 0:
+        cos = jnp.cos(angles)[None, :, None, :]  # (1, S, 1, half)
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:
+        cos = jnp.cos(angles)[:, :, None, :]  # (B, S, 1, half)
+        sin = jnp.sin(angles)[:, :, None, :]
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., :half], x32[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
@@ -225,6 +233,27 @@ def _dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     per decode step stays at the int8+scale footprint (~1.06 bytes per
     cached element vs 2 bf16 / 4 f32)."""
     return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def _store_decode_kv(var, val: jax.Array, pos: jax.Array) -> None:
+    """Write one decode step's per-row value ``val`` (B, 1, ...) into cache
+    variable ``var`` (B, max_seq_len, ...) at sequence position ``pos`` —
+    the one copy of the decode write used by K/V and their int8 scales.
+
+    Scalar ``pos``: every row writes the same position
+    (``dynamic_update_slice``, the generate() path). ``(B,)`` vector: each
+    row scatters at its own slot position (serve/); rows whose position is
+    outside the cache window are DROPPED, which is what makes parked /
+    finished slots safe to keep decoding — their writes vanish instead of
+    clamping onto (and corrupting) the last cache entry."""
+    val = val.astype(var.value.dtype)
+    if pos.ndim == 0:
+        var.value = jax.lax.dynamic_update_slice(
+            var.value, val, (0, pos) + (0,) * (val.ndim - 2)
+        )
+    else:
+        rows = jnp.arange(val.shape[0])
+        var.value = var.value.at[rows, pos].set(val[:, 0], mode="drop")
 
 
 def _expand_kv(kv: jax.Array, n_heads: int) -> jax.Array:
@@ -332,24 +361,20 @@ class Attention(nn.Module):
             cached_k, cached_v, idx, k_scale, v_scale = self._cache_vars(
                 b, k_raw.dtype, v.dtype
             )
+            # cache_index is () for generate() (one shared position) or
+            # (B,) for slot-indexed serving (serve/: each slot decodes at
+            # its own depth); apply_rope, _store_decode_kv, and the
+            # validity mask all branch on the trace-time rank
             pos = idx.value
             q = apply_rope(q_raw, cfg.rope_theta, offset=pos)
             k = apply_rope(k_raw, cfg.rope_theta, offset=pos)
             if k_scale is not None:  # int8 cache: store q + scale
                 k_q, k_s = _quantize_kv(k)
                 v_q, v_s = _quantize_kv(v)
-                cached_k.value = jax.lax.dynamic_update_slice(
-                    cached_k.value, k_q, (0, pos, 0, 0)
-                )
-                cached_v.value = jax.lax.dynamic_update_slice(
-                    cached_v.value, v_q, (0, pos, 0, 0)
-                )
-                k_scale.value = jax.lax.dynamic_update_slice(
-                    k_scale.value, k_s, (0, pos, 0)
-                )
-                v_scale.value = jax.lax.dynamic_update_slice(
-                    v_scale.value, v_s, (0, pos, 0)
-                )
+                _store_decode_kv(cached_k, k_q, pos)
+                _store_decode_kv(cached_v, v_q, pos)
+                _store_decode_kv(k_scale, k_s, pos)
+                _store_decode_kv(v_scale, v_s, pos)
                 k_read = _dequantize_kv(
                     cached_k.value, k_scale.value, k.dtype
                 )
@@ -357,14 +382,8 @@ class Attention(nn.Module):
                     cached_v.value, v_scale.value, v.dtype
                 )
             else:
-                cached_k.value = jax.lax.dynamic_update_slice(
-                    cached_k.value, k.astype(cached_k.value.dtype),
-                    (0, pos, 0, 0)
-                )
-                cached_v.value = jax.lax.dynamic_update_slice(
-                    cached_v.value, v.astype(cached_v.value.dtype),
-                    (0, pos, 0, 0)
-                )
+                _store_decode_kv(cached_k, k, pos)
+                _store_decode_kv(cached_v, v, pos)
                 k_read = cached_k.value
                 v_read = cached_v.value
             idx.value = pos + 1
@@ -372,10 +391,13 @@ class Attention(nn.Module):
             # same math as training/prefill. GQA: the cache holds kv_heads
             # and is read UN-expanded (grouped einsums) — per-step cache
             # traffic scales with n_kv_heads, the point of the layout
-            valid = jnp.arange(cfg.max_seq_len) <= pos  # (max_len,)
+            valid = (
+                jnp.arange(cfg.max_seq_len)[None, :]
+                <= (pos[:, None] if pos.ndim else pos)
+            )  # (1, max_len) shared — or (B, max_len) per slot
             out = grouped_masked_attention(
                 q, k_read, v_read,
-                valid[None, None, None, :],
+                valid[:, None, None, :],
             )
         else:
             q = apply_rope(q_raw, cfg.rope_theta)
@@ -554,6 +576,7 @@ class TransformerLM(nn.Module):
         decode: bool = False,
         prefill: bool = False,
         return_hidden: bool = False,
+        last_pos=None,
     ):
         cfg = self.cfg
         if cfg.quantized and cfg.moe_experts:
@@ -600,7 +623,19 @@ class TransformerLM(nn.Module):
             # only the last position's logits feed the next-token sample;
             # skip the (P-1) discarded lm_head rows — at serving widths the
             # head is the single largest matmul in the prefill
-            x = x[:, -1:]
+            if last_pos is None:
+                x = x[:, -1:]
+            else:
+                # bucketed prefill (serve/): prompts arrive right-padded to
+                # a static bucket length, so the next-token logits must be
+                # gathered at each row's LAST REAL prompt position (traced,
+                # per row) rather than the padding tail. Causal attention
+                # makes positions [0, P) independent of what follows, so
+                # the gathered hidden state equals the unpadded prefill's.
+                lp = jnp.broadcast_to(
+                    jnp.asarray(last_pos, jnp.int32), (x.shape[0],)
+                )
+                x = x[jnp.arange(x.shape[0]), lp][:, None]
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         if return_hidden:
             # the fused-loss seam: final-norm hidden states (B, S, d_model),

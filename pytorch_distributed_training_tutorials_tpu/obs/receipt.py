@@ -37,6 +37,7 @@ KINDS = frozenset({
     "profile_decode",    # scripts/profile_decode.py
     "launch_probe",      # scripts/launch_overhead_probe.py
     "obs_selftest",      # python -m ...obs --selftest
+    "serve_selftest",    # python -m ...serve --selftest
 })
 
 _ENVELOPE_KEYS = ("schema", "kind", "env", "drift")

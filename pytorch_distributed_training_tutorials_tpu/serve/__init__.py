@@ -1,0 +1,43 @@
+"""Continuous-batching LM serving: slot-indexed KV cache, launch-amortized
+decode chains, FIFO admission with backpressure.
+
+Public surface:
+
+- :class:`.engine.ServeEngine` — the engine (submit / step /
+  run_until_idle);
+- :class:`.scheduler.Request` / :class:`.scheduler.Completion` — the
+  request/response records;
+- :class:`.scheduler.FifoScheduler` / :class:`.scheduler.QueueFull` —
+  the host-side queue and its backpressure signal;
+- :func:`.slots.bucket_len` / :func:`.slots.init_slot_state` /
+  :func:`.slots.write_slot` — the slot-state building blocks (exposed
+  for tests and for engines over non-TransformerLM models).
+
+``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` runs the end-to-end smoke
+(token-exactness vs ``generate()`` included) and prints one receipt line
+— tier-1 wires it in via tests/test_serve.py.
+"""
+
+from pytorch_distributed_training_tutorials_tpu.serve.engine import ServeEngine
+from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
+    Completion,
+    FifoScheduler,
+    QueueFull,
+    Request,
+)
+from pytorch_distributed_training_tutorials_tpu.serve.slots import (
+    bucket_len,
+    init_slot_state,
+    write_slot,
+)
+
+__all__ = [
+    "Completion",
+    "FifoScheduler",
+    "QueueFull",
+    "Request",
+    "ServeEngine",
+    "bucket_len",
+    "init_slot_state",
+    "write_slot",
+]

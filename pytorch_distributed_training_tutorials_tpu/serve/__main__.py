@@ -1,0 +1,181 @@
+"""``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest``: end-to-end smoke of the
+continuous-batching engine on a tiny model, any backend.
+
+Exercises the whole serving loop the way tier-1 exercises ``obs``: a toy
+:class:`..models.transformer.TransformerLM` serves a staggered stream of
+mixed-length greedy requests through :class:`..serve.ServeEngine`, and
+every completion is checked TOKEN-EXACT against one-shot
+:func:`..models.generate.generate` of the same model/params — the
+continuous-batching machinery (slot refill, bucketed prefill, per-slot
+positions, chained decode) must be invisible in the outputs. Also pins
+backpressure (:class:`..serve.QueueFull`) and the fetch discipline (at
+most one ``jax.device_get`` per decode chain, counted by monkeypatching).
+Prints exactly one JSON line (a ``graft-receipt/v1`` envelope) and exits
+non-zero on any failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def selftest(json_path: str | None = None) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tutorials_tpu.models.generate import generate
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+    from pytorch_distributed_training_tutorials_tpu.obs import make_receipt, validate_receipt
+    from pytorch_distributed_training_tutorials_tpu.serve import QueueFull, Request, ServeEngine
+
+    problems: list[str] = []
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=4, max_seq_len=64
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+
+    engine = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=8, max_queue=2
+    )
+
+    # a staggered stream with mixed prompt lengths and budgets: 2 slots,
+    # 5 requests, the last submitted only after capacity frees up
+    rng = jax.random.PRNGKey(1)
+    prompts = []
+    for i, (p_len, max_new) in enumerate(
+        [(3, 9), (7, 12), (5, 1), (12, 6), (2, 17)]
+    ):
+        rng, sub = jax.random.split(rng)
+        toks = jax.device_get(
+            jax.random.randint(sub, (p_len,), 0, cfg.vocab_size)
+        ).tolist()
+        prompts.append((toks, max_new))
+
+    fetches = {"n": 0}
+    real_get = jax.device_get
+
+    def counting_get(x):
+        fetches["n"] += 1
+        return real_get(x)
+
+    jax.device_get = counting_get
+    try:
+        completions = {}
+        backpressured = False
+        pending = list(prompts)
+        # submit two, then drip the rest in as steps run — staggered
+        # arrivals against live slots
+        for toks, max_new in pending[:2]:
+            engine.submit(Request(prompt=toks, max_new_tokens=max_new))
+        pending = pending[2:]
+        while not engine.idle or pending:
+            while pending:
+                toks, max_new = pending[0]
+                try:
+                    engine.submit(
+                        Request(prompt=toks, max_new_tokens=max_new)
+                    )
+                    pending.pop(0)
+                except QueueFull:
+                    backpressured = True
+                    break
+            for c in engine.step():
+                completions[c.request_id] = c
+        n_chains, n_fetch = engine.n_chains, fetches["n"]
+    finally:
+        jax.device_get = real_get
+    if len(completions) != len(prompts):
+        problems.append(
+            f"{len(completions)} completions for {len(prompts)} requests"
+        )
+    # fetch discipline: one fetch per chain + one scalar per prefill
+    budget = n_chains + engine.n_prefills
+    if n_fetch > budget:
+        problems.append(
+            f"{n_fetch} host fetches > {budget} "
+            f"({n_chains} chains + {engine.n_prefills} prefills)"
+        )
+
+    # token-exactness vs one-shot generate(), greedy, per request
+    mismatches = 0
+    for rid, (toks, max_new) in enumerate(prompts):
+        ref = jax.device_get(
+            generate(
+                model, params, jnp.asarray([toks], jnp.int32), max_new
+            )
+        )[0, len(toks):].tolist()
+        if completions[rid].tokens != ref:
+            mismatches += 1
+            problems.append(
+                f"request {rid}: engine {completions[rid].tokens} != "
+                f"generate {ref}"
+            )
+    receipt = make_receipt(
+        "serve_selftest",
+        {
+            "n_requests": len(prompts),
+            "n_slots": 2,
+            "tokens_per_launch": 8,
+            "n_chains": n_chains,
+            "n_prefills": engine.n_prefills,
+            "host_fetches": n_fetch,
+            "generated_tokens": engine.generated_tokens,
+            "token_exact_mismatches": mismatches,
+            "backpressure_seen": backpressured,
+            "problems": problems,
+            "ok": not problems,
+        },
+    )
+    problems.extend(validate_receipt(receipt, kind="serve_selftest"))
+    receipt["ok"] = not problems
+    receipt["problems"] = problems
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(receipt, f, indent=2)
+            f.write("\n")
+    return receipt
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m pytorch_distributed_training_tutorials_tpu.serve")
+    parser.add_argument(
+        "--selftest", action="store_true",
+        help="run the end-to-end continuous-batching smoke test",
+    )
+    parser.add_argument(
+        "--json", default=None, help="also write the receipt to this path"
+    )
+    args = parser.parse_args(argv)
+    if not args.selftest:
+        parser.print_help()
+        return 2
+    # ad-hoc CPU runs need the config update as well as the env var
+    # (sitecustomize pre-imports jax._src — see CLAUDE.md)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        if "xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""
+        ):
+            # match the tier-1 forced 8-device mesh
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    receipt = selftest(args.json)
+    print(json.dumps(receipt))
+    return 0 if receipt["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,321 @@
+"""Continuous-batching serving engine: one compiled decode program,
+``n_slots`` concurrent requests, launch-amortized chains.
+
+The reference's serving story stops at loading Llama-7B for placement
+(``/root/reference/03.model_parallel.ipynb`` cell 2 — never generates a
+token; SURVEY.md section 5.7), and this repo's own ``generate()`` is
+one-shot batch inference: every request in the batch waits for the whole
+batch, and nobody new can join until the loop drains. This module is the
+Orca-style (OSDI '22) fix, built TPU-native:
+
+- ONE jitted decode program over a fixed ``(n_slots, ...)`` slot-indexed
+  KV cache (:mod:`.slots`); requests at different depths decode together,
+  each slot carrying its own position counter and active mask
+  (``remaining > 0``);
+- decode runs in CHAINS of ``tokens_per_launch`` steps per dispatch
+  (``lax.scan``, one launch + ONE batched ``jax.device_get`` for the
+  whole chain) because the floor on the tunneled runtime is per LAUNCH,
+  ~75-130 ms, regardless of how much work the launch carries (CLAUDE.md)
+  — per-token host syncs would be two orders of magnitude slower than
+  the device math;
+- finished slots are refilled in place by a jitted prefill-into-slot
+  (bucketed prompt lengths, :func:`.slots.bucket_len`; splice + position
+  reset, :func:`.slots.write_slot`) — no recompile per request, per
+  prompt length (beyond the bucket set), or per slot;
+- sampling is the SAME pipeline ``generate()`` uses
+  (:mod:`..models.sampling`), vmapped over per-slot PRNG streams: a
+  request's draws depend only on its own ``seed`` and draw index, never
+  on co-scheduling.
+
+Greedy decoding is token-exact vs one-shot ``generate()`` (same math,
+same cache semantics; pinned by tests/test_serve.py). Temperature /
+top-k / top-p are ENGINE-level statics — per-request sampling params
+would either recompile the decode program or drag filter branches into
+every step; per-request randomness comes from per-request seeds.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tutorials_tpu.models.sampling import (
+    sample_logits,
+    sample_logits_per_slot,
+)
+from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
+    Completion,
+    FifoScheduler,
+    Request,
+)
+from pytorch_distributed_training_tutorials_tpu.serve.slots import (
+    bucket_len,
+    init_slot_state,
+    write_slot,
+)
+
+
+class _Active:
+    """Host-side view of one occupied slot."""
+
+    __slots__ = ("request", "tokens", "remaining")
+
+    def __init__(self, request: Request, first_token: int):
+        self.request = request
+        self.tokens = [first_token]
+        self.remaining = request.max_new_tokens - 1
+
+
+class ServeEngine:
+    """Request-level LM serving over a slot-indexed KV cache.
+
+    ``model`` is a :class:`..models.transformer.TransformerLM` (or
+    anything with the same decode/prefill/``last_pos`` apply contract and
+    a ``cfg.max_seq_len``); its ``max_seq_len`` is the serving window
+    every slot gets. ``params`` stays caller-owned and read-only (share
+    one tree across engines; int8/TP placements pass straight through —
+    the engine never touches leaf placement).
+
+    Drive it with :meth:`submit` + :meth:`step`, or :meth:`run_until_idle`
+    to drain everything. ``step()`` does at most: one prefill launch per
+    freed slot (each with one scalar fetch of the first sampled token),
+    then ONE ``tokens_per_launch``-step decode chain with ONE batched
+    fetch — the no-per-token-host-sync contract tests/test_serve.py pins
+    with a monkeypatched ``jax.device_get``.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        n_slots: int = 4,
+        tokens_per_launch: int = 8,
+        max_queue: int = 64,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+    ):
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        if tokens_per_launch < 1:
+            raise ValueError("tokens_per_launch must be >= 1")
+        self.model = model
+        self.params = params
+        self.n_slots = n_slots
+        self.tokens_per_launch = tokens_per_launch
+        self.window = int(model.cfg.max_seq_len)
+        self.scheduler = FifoScheduler(self.window, max_queue=max_queue)
+        self._slots: list[_Active | None] = [None] * n_slots
+        self._state = init_slot_state(model, params, n_slots)
+        self._scan_layers = bool(getattr(model.cfg, "scan_layers", False))
+        self._temperature = float(temperature)
+        self._top_k = int(top_k)
+        self._top_p = float(top_p)
+        # stats for receipts
+        self.n_prefills = 0
+        self.n_chains = 0
+        self.generated_tokens = 0
+        # donating the state tree lets XLA update the multi-hundred-MB
+        # cache in place; CPU jit warns on donation (unsupported), so
+        # only donate where it is real
+        donate = (1,) if jax.default_backend() in ("tpu", "gpu") else ()
+        self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
+        self._chain = jax.jit(self._chain_fn, donate_argnums=donate)
+        self._park = jax.jit(
+            _park_slot, donate_argnums=(0,) if donate else ()
+        )
+
+    # ------------------------------------------------------------------
+    # compiled programs (closures over model + static sampling params)
+    # ------------------------------------------------------------------
+
+    def _prefill_fn(self, params, state, tokens, p_len, slot, seed,
+                    max_new):
+        """Prefill ``tokens`` (1, bucket) into slot ``slot``: one batched
+        forward populates the slot's K/V for ``[0, p_len)``, the first
+        token is sampled from the logits gathered at the last REAL prompt
+        position, and the slot's counters reset. All of ``p_len`` /
+        ``slot`` / ``seed`` / ``max_new`` are traced scalars — one
+        compile per prompt BUCKET, not per request."""
+        logits, upd = self.model.apply(
+            {"params": params}, tokens, prefill=True, mutable=["cache"],
+            last_pos=p_len - 1,
+        )
+        key = jax.random.PRNGKey(seed)
+        first, key = sample_logits(
+            logits[:, -1].astype(jnp.float32), key,
+            self._temperature, self._top_k, self._top_p,
+        )
+        cache = write_slot(
+            state["cache"], upd["cache"], slot, p_len, self._scan_layers
+        )
+        state = {
+            "cache": cache,
+            "last_tok": state["last_tok"].at[slot].set(first[0]),
+            "keys": state["keys"].at[slot].set(key),
+            # the first generated token is already accounted for
+            "remaining": state["remaining"].at[slot].set(max_new - 1),
+        }
+        return state, first[0]
+
+    def _chain_fn(self, params, state):
+        """``tokens_per_launch`` decode steps as one ``lax.scan`` — one
+        launch, one (S, T) token block out. Every slot steps every time
+        (fixed shapes); inactive slots re-emit their last token, their
+        K/V writes land at advancing positions whose reads are never
+        consumed (and drop once past the window — ``_store_decode_kv``
+        in models/transformer.py), and refill rewrites the whole slot
+        anyway."""
+
+        def step(carry, _):
+            cache, tok, keys, remaining = carry
+            active = remaining > 0
+            logits, upd = self.model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                decode=True, mutable=["cache"],
+            )
+            nxt, keys = sample_logits_per_slot(
+                logits[:, -1].astype(jnp.float32), keys,
+                self._temperature, self._top_k, self._top_p,
+            )
+            nxt = jnp.where(active, nxt, tok)
+            remaining = remaining - active.astype(remaining.dtype)
+            return (upd["cache"], nxt, keys, remaining), nxt
+
+        carry = (
+            state["cache"], state["last_tok"], state["keys"],
+            state["remaining"],
+        )
+        (cache, tok, keys, remaining), toks = jax.lax.scan(
+            step, carry, None, length=self.tokens_per_launch
+        )
+        state = {
+            "cache": cache, "last_tok": tok, "keys": keys,
+            "remaining": remaining,
+        }
+        return state, toks.T  # (n_slots, tokens_per_launch)
+
+    # ------------------------------------------------------------------
+    # host-side driver
+    # ------------------------------------------------------------------
+
+    def submit(self, request: Request) -> int:
+        """Enqueue one request; returns its id. Raises
+        :class:`..serve.scheduler.QueueFull` when the bounded queue is at
+        capacity (backpressure) or ``ValueError`` when the request can
+        never fit the window."""
+        return self.scheduler.submit(request)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(a is not None for a in self._slots)
+
+    @property
+    def idle(self) -> bool:
+        return self.active_slots == 0 and len(self.scheduler) == 0
+
+    def step(self) -> list[Completion]:
+        """One scheduling round: refill free slots from the queue (one
+        prefill launch each), then run ONE decode chain over all slots
+        and hand out its tokens. Returns the requests that finished this
+        round (possibly mid-chain — surplus chain tokens for a finished
+        slot are discarded, exactly like ``generate()`` truncating at
+        ``max_new_tokens``)."""
+        done: list[Completion] = []
+        for s in range(self.n_slots):
+            if self._slots[s] is not None:
+                continue
+            req = self.scheduler.pop()
+            if req is None:
+                break
+            done.extend(self._refill(s, req))
+        if self.active_slots:
+            self._state, toks = self._chain(self.params, self._state)
+            self.n_chains += 1
+            toks = jax.device_get(toks)  # the chain's ONE host fetch
+            done.extend(self._distribute(toks))
+        return done
+
+    def run_until_idle(self, max_steps: int = 10_000) -> list[Completion]:
+        """Drain queue + slots; returns completions in finish order."""
+        out: list[Completion] = []
+        for _ in range(max_steps):
+            if self.idle:
+                return out
+            out.extend(self.step())
+        raise RuntimeError(f"not idle after {max_steps} steps")
+
+    def _refill(self, slot: int, req: Request) -> list[Completion]:
+        """Prefill ``req`` into ``slot``. One launch + one scalar fetch
+        (the first sampled token — needed host-side for EOS/max_new==1
+        admission into the decode phase)."""
+        prompt = [int(t) for t in req.prompt]
+        p_len = len(prompt)
+        bucket = bucket_len(p_len, self.window)
+        padded = prompt + [0] * (bucket - p_len)
+        tokens = jnp.asarray([padded], jnp.int32)
+        self._state, first = self._prefill(
+            self.params, self._state, tokens, p_len, slot, req.seed,
+            req.max_new_tokens,
+        )
+        self.n_prefills += 1
+        first = int(jax.device_get(first))
+        self.generated_tokens += 1
+        act = _Active(req, first)
+        if req.max_new_tokens == 1 or first == req.eos_token:
+            reason = "eos" if first == req.eos_token else "length"
+            if act.remaining > 0:
+                # early EOS: the device-side counter still shows budget;
+                # park the slot so later chains treat it as inactive
+                self._state["remaining"] = self._park(
+                    self._state["remaining"], slot
+                )
+            return [self._complete(act, reason)]
+        self._slots[slot] = act
+        return []
+
+    def _distribute(self, toks) -> list[Completion]:
+        """Hand one fetched (S, T) chain block out to the slots' host
+        views; free every slot that finished (budget exhausted or EOS
+        mid-chain) and park early-EOS slots whose device counter still
+        shows budget."""
+        done: list[Completion] = []
+        for s, act in enumerate(self._slots):
+            if act is None:
+                continue
+            reason = None
+            for t in toks[s, : act.remaining]:
+                tok = int(t)
+                act.tokens.append(tok)
+                act.remaining -= 1
+                self.generated_tokens += 1
+                if tok == act.request.eos_token:
+                    reason = "eos"
+                    break
+            if reason is None and act.remaining == 0:
+                reason = "length"
+            if reason is not None:
+                self._slots[s] = None
+                if act.remaining > 0:  # finished mid-chain via EOS
+                    self._state["remaining"] = self._park(
+                        self._state["remaining"], s
+                    )
+                done.append(self._complete(act, reason))
+        return done
+
+    def _complete(self, act: _Active, reason: str) -> Completion:
+        return Completion(
+            request_id=act.request.request_id,
+            prompt=[int(t) for t in act.request.prompt],
+            tokens=act.tokens,
+            finish_reason=reason,
+            latency_s=time.perf_counter() - act.request.submitted_s,
+        )
+
+
+def _park_slot(remaining, slot):
+    """Zero one slot's device-side budget counter (host freed it early)."""
+    return remaining.at[slot].set(0)

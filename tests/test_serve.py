@@ -1,0 +1,397 @@
+"""The continuous-batching serving engine (serve/).
+
+The load-bearing pins:
+
+- greedy continuous-batching output is TOKEN-EXACT vs one-shot
+  ``generate()`` for staggered arrivals with mixed prompt lengths — slot
+  refill, bucketed prefill, per-slot positions, and chained decode must
+  be invisible in the outputs (the ISSUE 5 acceptance criterion), across
+  the unrolled, ``scan_layers``, and GQA layouts;
+- a monkeypatched ``jax.device_get`` proves the fetch discipline: ONE
+  batched host fetch per ``tokens_per_launch``-step decode chain plus one
+  scalar per prefill — never a per-token sync (the per-LAUNCH floor is
+  the whole point of chaining, CLAUDE.md);
+- scheduler edge cases: slot exhaustion + ``QueueFull`` backpressure,
+  admission rejects requests that can never fit the window, FIFO order,
+  a request finishing mid-chain, ``max_new_tokens == 1`` (completes at
+  prefill, no decode chain at all), and EOS early-stop with slot parking;
+- sampled requests are reproducible functions of their OWN seed — the
+  same request returns the same tokens no matter what else shares the
+  batch (per-slot PRNG streams, models/sampling.py);
+- ``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` succeeds in a
+  subprocess (the tier-1 wiring for the end-to-end smoke).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.models.generate import generate
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from pytorch_distributed_training_tutorials_tpu.serve import (
+    QueueFull,
+    Request,
+    ServeEngine,
+    bucket_len,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, max_seq_len=64
+)
+
+
+def _make(cfg=CFG, seed=0):
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def _prompt(seed, p_len, vocab=CFG.vocab_size):
+    return jax.device_get(
+        jax.random.randint(jax.random.PRNGKey(seed), (p_len,), 0, vocab)
+    ).tolist()
+
+
+def _reference(model, params, prompt, max_new):
+    """One-shot greedy generate(), new tokens only."""
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32), max_new)
+    return jax.device_get(out)[0, len(prompt):].tolist()
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    return _make()
+
+
+# ------------------------------------------------- the acceptance criterion
+
+def test_token_exact_staggered_mixed_lengths(model_params):
+    """2 slots, 5 staggered requests with mixed prompt lengths/budgets:
+    every completion matches one-shot generate() token for token."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    reqs = [(3, 9), (7, 12), (5, 5), (12, 6), (2, 17)]
+    prompts = [_prompt(100 + i, p) for i, (p, _) in enumerate(reqs)]
+    # two submitted up front; the rest arrive between scheduling rounds
+    ids = [
+        engine.submit(Request(prompt=prompts[i], max_new_tokens=reqs[i][1]))
+        for i in range(2)
+    ]
+    pending = list(range(2, len(reqs)))
+    completions = {}
+    while not engine.idle or pending:
+        if pending:
+            i = pending.pop(0)
+            ids.append(
+                engine.submit(
+                    Request(prompt=prompts[i], max_new_tokens=reqs[i][1])
+                )
+            )
+        for c in engine.step():
+            completions[c.request_id] = c
+    assert sorted(completions) == sorted(ids)
+    for i, (p_len, max_new) in enumerate(reqs):
+        ref = _reference(model, params, prompts[i], max_new)
+        got = completions[ids[i]].tokens
+        assert got == ref, f"request {i}: {got} != {ref}"
+        assert completions[ids[i]].finish_reason == "length"
+        assert completions[ids[i]].latency_s > 0
+
+
+@pytest.mark.parametrize(
+    "cfg_kwargs",
+    [
+        dict(scan_layers=True),
+        dict(n_kv_heads=2),
+    ],
+    ids=["scan_layers", "gqa"],
+)
+def test_token_exact_variant_layouts(cfg_kwargs):
+    """The slot surgery handles the nn.scan-stacked cache (leading layer
+    axis on every leaf) and the GQA-shrunk cache the same as the plain
+    layout: still token-exact vs generate()."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, **cfg_kwargs)
+    model, params = _make(cfg)
+    engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    reqs = [(4, 10), (9, 7), (6, 12)]
+    prompts = [_prompt(200 + i, p) for i, (p, _) in enumerate(reqs)]
+    ids = [
+        engine.submit(Request(prompt=prompts[i], max_new_tokens=m))
+        for i, (_, m) in enumerate(reqs)
+    ]
+    completions = {c.request_id: c for c in engine.run_until_idle()}
+    for i, (_, max_new) in enumerate(reqs):
+        ref = _reference(model, params, prompts[i], max_new)
+        assert completions[ids[i]].tokens == ref
+
+
+def test_int8_kv_cache_smoke():
+    """int8 KV storage (per-position scales ride the same slot surgery):
+    the engine runs and respects budgets. Exactness vs generate() is not
+    pinned here — the rounded cache makes near-ties layout-sensitive
+    (CLAUDE.md's kv_cache_dtype caveat)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, kv_cache_dtype=jnp.int8)
+    model, params = _make(cfg)
+    engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    ids = [
+        engine.submit(
+            Request(prompt=_prompt(300 + i, 5 + i), max_new_tokens=6 + i)
+        )
+        for i in range(3)
+    ]
+    completions = {c.request_id: c for c in engine.run_until_idle()}
+    for i, rid in enumerate(ids):
+        assert len(completions[rid].tokens) == 6 + i
+        assert all(
+            0 <= t < cfg.vocab_size for t in completions[rid].tokens
+        )
+
+
+# --------------------------------------------------------- fetch discipline
+
+def test_one_fetch_per_chain(model_params, monkeypatch):
+    """<= 1 host fetch per tokens_per_launch-step decode chain (plus one
+    scalar per prefill): the no-per-token-sync contract, counted by
+    monkeypatching jax.device_get — the one attribute the engine fetches
+    through."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    prompts = [_prompt(400 + i, 4 + 3 * i) for i in range(3)]
+    calls = {"n": 0}
+    real_get = jax.device_get
+    monkeypatch.setattr(
+        jax, "device_get",
+        lambda x: (calls.__setitem__("n", calls["n"] + 1), real_get(x))[1],
+    )
+    for p in prompts:
+        engine.submit(Request(prompt=p, max_new_tokens=20))
+    completions = engine.run_until_idle()
+    assert len(completions) == 3
+    assert engine.n_chains >= 3  # 20 tokens at 8/launch, multiple rounds
+    # the whole run: one fetch per chain + one per prefill, nothing else
+    assert calls["n"] == engine.n_chains + engine.n_prefills
+    total_tokens = sum(len(c.tokens) for c in completions)
+    assert total_tokens == 60
+    # amortization: far fewer fetches than generated tokens
+    assert calls["n"] * engine.tokens_per_launch >= total_tokens
+
+
+# ------------------------------------------------- scheduler + admission
+
+def test_backpressure_queue_full(model_params):
+    model, params = model_params
+    engine = ServeEngine(
+        model, params, n_slots=1, tokens_per_launch=8, max_queue=2
+    )
+    for i in range(2):
+        engine.submit(Request(prompt=_prompt(500 + i, 3), max_new_tokens=4))
+    with pytest.raises(QueueFull):
+        engine.submit(Request(prompt=_prompt(502, 3), max_new_tokens=4))
+    # draining frees queue capacity: the same request is admissible after
+    done = engine.run_until_idle()
+    assert len(done) == 2
+    rid = engine.submit(Request(prompt=_prompt(502, 3), max_new_tokens=4))
+    assert rid == 2
+    assert len(engine.run_until_idle()) == 1
+
+
+def test_admission_validation(model_params):
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=1)
+    with pytest.raises(ValueError):
+        engine.submit(Request(prompt=[], max_new_tokens=4))
+    with pytest.raises(ValueError):
+        engine.submit(Request(prompt=[1, 2], max_new_tokens=0))
+    with pytest.raises(ValueError):  # can never fit the 64-token window
+        engine.submit(Request(prompt=[1] * 30, max_new_tokens=40))
+    assert engine.idle  # nothing slipped into the queue
+
+
+def test_fifo_order(model_params):
+    """Same-shape requests complete in arrival order on one slot."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=1, tokens_per_launch=8)
+    ids = [
+        engine.submit(Request(prompt=_prompt(600 + i, 4), max_new_tokens=3))
+        for i in range(3)
+    ]
+    done = engine.run_until_idle()
+    assert [c.request_id for c in done] == ids
+
+
+def test_finish_mid_chain(model_params):
+    """A budget that is not a chain multiple finishes mid-chain; surplus
+    chain tokens are discarded and a co-scheduled longer request stays
+    token-exact."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    p_short, p_long = _prompt(700, 5), _prompt(701, 6)
+    i_short = engine.submit(Request(prompt=p_short, max_new_tokens=3))
+    i_long = engine.submit(Request(prompt=p_long, max_new_tokens=19))
+    completions = {c.request_id: c for c in engine.run_until_idle()}
+    assert completions[i_short].tokens == _reference(
+        model, params, p_short, 3
+    )
+    assert completions[i_long].tokens == _reference(
+        model, params, p_long, 19
+    )
+
+
+def test_max_new_tokens_one(model_params):
+    """max_new_tokens == 1 completes straight out of prefill — the decode
+    chain never runs."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=1, tokens_per_launch=8)
+    prompt = _prompt(800, 6)
+    rid = engine.submit(Request(prompt=prompt, max_new_tokens=1))
+    done = engine.step()
+    assert [c.request_id for c in done] == [rid]
+    assert done[0].tokens == _reference(model, params, prompt, 1)
+    assert done[0].finish_reason == "length"
+    assert engine.n_chains == 0
+    assert engine.idle
+
+
+def test_eos_early_stop(model_params):
+    """EOS sampled mid-stream stops the request (stop token included),
+    parks the slot, and the engine keeps serving: a follow-up request on
+    the freed slot is still token-exact."""
+    model, params = model_params
+    prompt = _prompt(900, 5)
+    ref = _reference(model, params, prompt, 12)
+    eos = ref[4]  # force a stop 5 tokens in
+    stop_at = ref.index(eos) + 1  # first occurrence wins
+    engine = ServeEngine(model, params, n_slots=1, tokens_per_launch=8)
+    rid = engine.submit(
+        Request(prompt=prompt, max_new_tokens=12, eos_token=eos)
+    )
+    done = engine.run_until_idle()
+    assert [c.request_id for c in done] == [rid]
+    assert done[0].finish_reason == "eos"
+    assert done[0].tokens == ref[:stop_at]
+    # the freed (parked) slot serves the next request exactly
+    p2 = _prompt(901, 7)
+    engine.submit(Request(prompt=p2, max_new_tokens=6))
+    done2 = engine.run_until_idle()
+    assert done2[0].tokens == _reference(model, params, p2, 6)
+
+
+def test_eos_at_first_token(model_params):
+    """EOS on the prefill-sampled token completes without any chain."""
+    model, params = model_params
+    prompt = _prompt(902, 4)
+    first = _reference(model, params, prompt, 1)[0]
+    engine = ServeEngine(model, params, n_slots=1, tokens_per_launch=8)
+    engine.submit(
+        Request(prompt=prompt, max_new_tokens=9, eos_token=first)
+    )
+    done = engine.step()
+    assert done[0].finish_reason == "eos"
+    assert done[0].tokens == [first]
+    assert engine.n_chains == 0
+    assert engine.idle
+
+
+# ------------------------------------------------------------- sampling
+
+def test_sampled_tokens_reproducible_per_seed(model_params):
+    """temperature > 0: a request's tokens are a function of its own seed
+    — identical whether it runs alone or co-scheduled with strangers."""
+    model, params = model_params
+    prompt = _prompt(1000, 5)
+    req = dict(prompt=prompt, max_new_tokens=10, seed=7)
+
+    engine_solo = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=8, temperature=1.0
+    )
+    rid = engine_solo.submit(Request(**req))
+    solo = {c.request_id: c for c in engine_solo.run_until_idle()}[rid]
+
+    engine_busy = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=8, temperature=1.0
+    )
+    engine_busy.submit(
+        Request(prompt=_prompt(1001, 9), max_new_tokens=14, seed=3)
+    )
+    rid_busy = engine_busy.submit(Request(**req))
+    engine_busy.submit(
+        Request(prompt=_prompt(1002, 3), max_new_tokens=6, seed=11)
+    )
+    busy = {c.request_id: c for c in engine_busy.run_until_idle()}[rid_busy]
+
+    assert solo.tokens == busy.tokens
+    # and a different seed actually changes the draw stream
+    engine_other = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=8, temperature=1.0
+    )
+    rid2 = engine_other.submit(Request(**{**req, "seed": 8}))
+    other = {c.request_id: c for c in engine_other.run_until_idle()}[rid2]
+    assert other.tokens != solo.tokens
+
+
+# ------------------------------------------------------------- slot utils
+
+def test_bucket_len():
+    assert bucket_len(1, 64) == 8
+    assert bucket_len(8, 64) == 8
+    assert bucket_len(9, 64) == 16
+    assert bucket_len(33, 64) == 64
+    assert bucket_len(60, 64) == 64
+    assert bucket_len(5, 6) == 6  # capped at a non-pow2 window
+    with pytest.raises(ValueError):
+        bucket_len(0, 64)
+
+
+def test_bucketing_reuses_compiles(model_params):
+    """Prompt lengths inside one bucket share a prefill compile: serving
+    many distinct lengths traces at most one program per bucket."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=1, tokens_per_launch=8)
+    for i, p_len in enumerate([3, 5, 8, 11, 16, 2]):  # buckets {8, 16}
+        engine.submit(
+            Request(prompt=_prompt(1100 + i, p_len), max_new_tokens=2)
+        )
+    engine.run_until_idle()
+    # jit caches per tokens shape: (1, 8) and (1, 16) only
+    assert engine._prefill._cache_size() == 2
+
+
+# ------------------------------------------------------------- the selftest
+
+def test_serve_selftest_subprocess(tmp_path):
+    """``python -m ...serve --selftest`` — the end-to-end continuous-
+    batching smoke (token-exactness vs generate() included) — succeeds on
+    the forced 8-device CPU mesh."""
+    from pytorch_distributed_training_tutorials_tpu.obs import load_receipt, validate_receipt
+
+    json_path = str(tmp_path / "selftest.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_training_tutorials_tpu.serve", "--selftest",
+         "--json", json_path],
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+        env=os.environ.copy(),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    receipt = json.loads(out.stdout.strip().splitlines()[-1])
+    assert receipt["ok"] is True, receipt.get("problems")
+    assert validate_receipt(receipt, kind="serve_selftest") == []
+    assert receipt["token_exact_mismatches"] == 0
+    assert receipt["backpressure_seen"] is True
+    assert load_receipt(json_path)["ok"] is True

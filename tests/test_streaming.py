@@ -110,7 +110,11 @@ def test_chunked_transform_runs_in_scan():
                 loss="cross_entropy")
     first = t._run_epoch(0)
     last = t.train(3)
-    assert np.isfinite(first["loss"]) and last["loss"] <= first["loss"]
+    # the pin is "the uint8->f32 transform trains through the chunk scan",
+    # not optimization progress: 2 sgd steps/epoch on random labels is not
+    # monotone (observed +0.005 wobble), so require finite + not diverging
+    assert np.isfinite(first["loss"]) and np.isfinite(last["loss"])
+    assert last["loss"] <= first["loss"] + 0.05
 
 
 def test_chunked_grad_accum_falls_back_to_per_step():
