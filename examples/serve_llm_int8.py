@@ -240,6 +240,19 @@ def main():
         "floor is per DISPATCH — longer chains amortize it)",
     )
     ap.add_argument(
+        "--prefix-overlap", type=float, default=0.0, dest="prefix_overlap",
+        help="for --server: fraction [0..1] of each prompt drawn from one "
+        "shared prefix family (the rest is a per-request random tail) — "
+        "synthesizes the shared-system-prompt workload the radix prefix "
+        "cache (serve.PrefixIndex) targets; the receipt gains hit rate, "
+        "splice counts, and TTFT p50/p95",
+    )
+    ap.add_argument(
+        "--prefix-cache-mb", type=int, default=None, dest="prefix_cache_mb",
+        help="prefix-cache byte budget in MiB for --server (0 disables; "
+        "default: 512 when --prefix-overlap > 0, else 0)",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -509,7 +522,15 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     completion's tokens come off a fetched chain block, so latencies are
     fetch-backed, not async mirages) and aggregate generated tok/s.
     Compile happens on a warmup request per prompt bucket BEFORE the
-    timed stream, mirroring the one-shot leg's compile/serve split."""
+    timed stream, mirroring the one-shot leg's compile/serve split.
+
+    ``--prefix-overlap r`` draws the first ``round(r * p_len)`` tokens of
+    every prompt from ONE shared token family (the shared-system-prompt
+    workload), so the radix prefix cache (serve.PrefixIndex) can retain
+    and splice it; the warmup stream uses the same family, so the timed
+    stream measures the STEADY state (cache warm, splice path compiled)
+    and the receipt gains hit rate, splice counts, and TTFT p50/p95
+    (submit to first token, the latency prefix reuse actually moves)."""
     import jax
     import numpy as np
 
@@ -524,6 +545,9 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
             min(args.prompt_len + args.prompt_len // 2, window - new),
         }
     )
+    cache_mb = args.prefix_cache_mb
+    if cache_mb is None:
+        cache_mb = 512 if args.prefix_overlap > 0 else 0
     engine = ServeEngine(
         lm, params,
         n_slots=args.slots,
@@ -532,26 +556,37 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         temperature=args.temperature,
         top_k=args.top_k,
         top_p=args.top_p,
+        prefix_cache_bytes=cache_mb * 1024 * 1024,
     )
     rng = np.random.Generator(np.random.PCG64(11))
+    # one shared token family: request i's prompt = shared[:k] + tail,
+    # k = round(overlap * p_len) — every prompt of the stream shares its
+    # head with every other, the trie's best case at overlap 1.0 and a
+    # plain random stream at 0.0
+    shared = rng.integers(0, cfg.vocab_size, (max(lengths),)).tolist()
 
     def mk_request(i: int) -> Request:
         p_len = lengths[i % len(lengths)]
+        k = min(p_len, int(round(args.prefix_overlap * p_len)))
+        tail = rng.integers(0, cfg.vocab_size, (p_len - k,)).tolist()
         return Request(
-            prompt=rng.integers(0, cfg.vocab_size, (p_len,)).tolist(),
-            max_new_tokens=new,
-            seed=i,
+            prompt=shared[:k] + tail, max_new_tokens=new, seed=i
         )
 
     # compile warmup: one request per prompt bucket + the decode chain,
     # outside the timed stream (compile is the multi-second cost; the
-    # stream receipt should measure serving, not tracing)
+    # stream receipt should measure serving, not tracing). With overlap
+    # the warmup also compiles the suffix splice buckets and leaves the
+    # shared family resident, so the timed stream is steady-state.
     t0 = time.perf_counter()
     for i in range(len(lengths)):
         engine.submit(mk_request(i))
     engine.run_until_idle()
     compile_s = time.perf_counter() - t0
     engine.n_chains = engine.n_prefills = engine.generated_tokens = 0
+    engine.n_splices = engine.prefix_hit_tokens = 0
+    if engine.prefix is not None:
+        engine.prefix.hits = engine.prefix.misses = 0
 
     t0 = time.perf_counter()
     for i in range(args.requests):
@@ -564,6 +599,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     wall_s = time.perf_counter() - t0
 
     lat = np.asarray(sorted(c.latency_s for c in completions))
+    ttft = np.asarray(sorted(c.ttft_s for c in completions))
     toks = engine.generated_tokens
     receipt.update(
         server=True,
@@ -581,16 +617,30 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         server_prefills=engine.n_prefills,
         server_p50_latency_s=round(float(np.percentile(lat, 50)), 3),
         server_p95_latency_s=round(float(np.percentile(lat, 95)), 3),
+        server_ttft_p50_s=round(float(np.percentile(ttft, 50)), 3),
+        server_ttft_p95_s=round(float(np.percentile(ttft, 95)), 3),
         server_compile_s=round(compile_s, 1),
+        prefix_overlap=args.prefix_overlap,
+        prefix_cache_mb=cache_mb,
+        **engine.prefix_stats(),
         backend=jax.default_backend(),
     )
+    prefix_note = ""
+    if engine.prefix is not None:
+        st = engine.prefix_stats()
+        prefix_note = (
+            f", prefix hit rate {st['prefix_hit_rate']:.2f} "
+            f"({engine.n_splices} splices, {engine.prefix_hit_tokens} "
+            f"tokens reused)"
+        )
     print(
         f"server: {args.requests} requests (prompts {lengths}, {new} new "
         f"each) over {args.slots} slots in {wall_s:.2f}s — "
         f"{toks / wall_s:.1f} tok/s, p50 {receipt['server_p50_latency_s']}s "
-        f"/ p95 {receipt['server_p95_latency_s']}s per request, "
-        f"{engine.n_chains} chains + {engine.n_prefills} prefills "
-        f"(compile {compile_s:.0f}s)"
+        f"/ p95 {receipt['server_p95_latency_s']}s per request, ttft p50 "
+        f"{receipt['server_ttft_p50_s']}s, "
+        f"{engine.n_chains} chains + {engine.n_prefills} prefills"
+        f"{prefix_note} (compile {compile_s:.0f}s)"
     )
 
 

@@ -1,10 +1,36 @@
-"""Benchmark harness (twin of reference C17)."""
+"""Benchmark harness (twin of reference C17).
 
-from pytorch_distributed_training_tutorials_tpu.bench.harness import (  # noqa: F401
-    benchmark,
-    BenchResult,
-)
+Re-exports are PEP 562 lazy (same pattern as the top-level package
+init): importing ``pytorch_distributed_training_tutorials_tpu.bench`` does not import jax, so the
+jax-free :mod:`.regress` receipt gate can live here without dragging a
+backend into CI. Heavyweight legs stay import-lazy too: bench.headline /
+bench.scaling / bench.lm_headline are CLI modules (``python -m ...``)
+and import jax state on use, not at package import
+(tests/test_import_purity.py).
+"""
 
-# heavyweight legs stay import-lazy: bench.headline / bench.scaling /
-# bench.lm_headline are CLI modules (python -m ...) and import jax state
-# on use, not at package import (tests/test_import_purity.py)
+import importlib
+
+# name -> submodule; resolved on first access via __getattr__.
+_LAZY_EXPORTS = {
+    "benchmark": "pytorch_distributed_training_tutorials_tpu.bench.harness",
+    "BenchResult": "pytorch_distributed_training_tutorials_tpu.bench.harness",
+}
+
+__all__ = sorted(_LAZY_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module_name = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))

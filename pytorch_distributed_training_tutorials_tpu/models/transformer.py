@@ -236,24 +236,30 @@ def _dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
 
 
 def _store_decode_kv(var, val: jax.Array, pos: jax.Array) -> None:
-    """Write one decode step's per-row value ``val`` (B, 1, ...) into cache
-    variable ``var`` (B, max_seq_len, ...) at sequence position ``pos`` —
-    the one copy of the decode write used by K/V and their int8 scales.
+    """Write one decode chunk's per-row value ``val`` (B, S, ...) into cache
+    variable ``var`` (B, max_seq_len, ...) at sequence positions
+    ``pos + [0, S)`` — the one copy of the decode write used by K/V and
+    their int8 scales.
 
-    Scalar ``pos``: every row writes the same position
-    (``dynamic_update_slice``, the generate() path). ``(B,)`` vector: each
-    row scatters at its own slot position (serve/); rows whose position is
-    outside the cache window are DROPPED, which is what makes parked /
-    finished slots safe to keep decoding — their writes vanish instead of
+    Scalar ``pos`` with ``S == 1``: every row writes the same position
+    (``dynamic_update_slice``, the generate() path — kept as the exact
+    pre-existing lowering). Every other case — ``(B,)`` vector ``pos``
+    (serve/ slot-indexed decode) and/or ``S > 1`` (suffix prefill of a
+    prefix-cache hit, bucket-padded) — scatters row r's token s at
+    position ``pos[r] + s``; positions outside the cache window are
+    DROPPED, which is what makes parked / finished slots AND bucket
+    padding past the window safe — their writes vanish instead of
     clamping onto (and corrupting) the last cache entry."""
     val = val.astype(var.value.dtype)
-    if pos.ndim == 0:
+    s = val.shape[1]
+    if pos.ndim == 0 and s == 1:
         var.value = jax.lax.dynamic_update_slice(
             var.value, val, (0, pos) + (0,) * (val.ndim - 2)
         )
     else:
-        rows = jnp.arange(val.shape[0])
-        var.value = var.value.at[rows, pos].set(val[:, 0], mode="drop")
+        rows = jnp.arange(val.shape[0])[:, None]  # (B, 1)
+        cols = (pos[:, None] if pos.ndim else pos) + jnp.arange(s)  # (B|1, S)
+        var.value = var.value.at[rows, cols].set(val, mode="drop")
 
 
 def _expand_kv(kv: jax.Array, n_heads: int) -> jax.Array:
@@ -346,18 +352,20 @@ class Attention(nn.Module):
         v = proj("v_proj", kv)(x)
 
         if decode:
-            # incremental decoding: one token in, KV appended to the cache,
-            # attention over the cache prefix. Cache tensors are zero-init
-            # on the first (shape-init) apply and thereafter carry state.
-            # Contract: the caller drives at most max_seq_len steps
-            # (generate() enforces; past that, dynamic_update_slice would
-            # clamp the write index and silently corrupt the last slot).
+            # incremental decoding: S tokens in (S == 1 for the classic
+            # generate()/serve step; S > 1 is a CHUNKED continuation — the
+            # suffix prefill of a prefix-cache hit, serve/engine.py), KV
+            # appended to the cache at positions pos + [0, S), attention
+            # over the cache prefix. Cache tensors are zero-init on the
+            # first (shape-init) apply and thereafter carry state.
+            # Contract: the caller keeps REAL positions under max_seq_len
+            # (generate() enforces; serve/ admission-checks) — writes past
+            # the window (bucket padding) drop in _store_decode_kv.
             # Note decode always uses this dense cached path — a custom
             # cfg.attention_fn (ring/Ulysses) governs training/prefill
             # only; a *non-equivalent* attention_fn (e.g. sliding window)
             # would need its own decode rule.
-            b = x.shape[0]
-            assert x.shape[1] == 1, "decode=True expects one token at a time"
+            b, s = x.shape[0], x.shape[1]
             cached_k, cached_v, idx, k_scale, v_scale = self._cache_vars(
                 b, k_raw.dtype, v.dtype
             )
@@ -386,18 +394,23 @@ class Attention(nn.Module):
                 _store_decode_kv(cached_v, v, pos)
                 k_read = cached_k.value
                 v_read = cached_v.value
-            idx.value = pos + 1
-            # attend over the whole cache, masking positions beyond `pos`;
-            # same math as training/prefill. GQA: the cache holds kv_heads
-            # and is read UN-expanded (grouped einsums) — per-step cache
-            # traffic scales with n_kv_heads, the point of the layout
+            idx.value = pos + s
+            # attend over the whole cache: query token i (global position
+            # pos + i) masks positions beyond pos + i — same math as
+            # training/prefill (a masked-out cache column contributes an
+            # exact softmax zero, so window-vs-prompt-sized reductions
+            # agree bitwise). GQA: the cache holds kv_heads and is read
+            # UN-expanded (grouped einsums) — per-step cache traffic
+            # scales with n_kv_heads, the point of the layout
+            qpos = (pos[..., None] if pos.ndim else pos) + jnp.arange(s)
             valid = (
-                jnp.arange(cfg.max_seq_len)[None, :]
-                <= (pos[:, None] if pos.ndim else pos)
-            )  # (1, max_len) shared — or (B, max_len) per slot
+                jnp.arange(cfg.max_seq_len) <= qpos[..., :, None]
+            )  # (S, max_len) shared — or (B, S, max_len) per slot
+            if valid.ndim == 2:
+                valid = valid[None]
             out = grouped_masked_attention(
                 q, k_read, v_read,
-                valid[:, None, None, :],
+                valid[:, None, :, :],
             )
         else:
             q = apply_rope(q_raw, cfg.rope_theta)
@@ -619,7 +632,7 @@ class TransformerLM(nn.Module):
             )
             for i in range(cfg.n_layers):
                 x = block_cls(cfg, name=f"block_{i}")(x, decode, prefill)
-        if prefill:
+        if prefill or (decode and last_pos is not None):
             # only the last position's logits feed the next-token sample;
             # skip the (P-1) discarded lm_head rows — at serving widths the
             # head is the single largest matmul in the prefill
@@ -632,6 +645,11 @@ class TransformerLM(nn.Module):
                 # per row) rather than the padding tail. Causal attention
                 # makes positions [0, P) independent of what follows, so
                 # the gathered hidden state equals the unpadded prefill's.
+                # The decode=True variant is the chunked SUFFIX prefill of
+                # a prefix-cache hit (serve/engine.py): ``last_pos`` is the
+                # LOCAL index of the last real suffix token. decode with
+                # last_pos=None keeps the full (B, S, V) logits — the
+                # generate()/serve chain contract (S == 1) is unchanged.
                 lp = jnp.broadcast_to(
                     jnp.asarray(last_pos, jnp.int32), (x.shape[0],)
                 )

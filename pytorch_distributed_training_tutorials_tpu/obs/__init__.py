@@ -18,29 +18,49 @@ CLAUDE.md prose. ``obs`` is that lore as library code, in four pillars:
 
 ``python -m pytorch_distributed_training_tutorials_tpu.obs --selftest`` smoke-runs all four on a
 tiny CPU-mesh workload.
+
+The re-exports below are PEP 562 LAZY (same pattern as the top-level
+package init): importing ``pytorch_distributed_training_tutorials_tpu.obs`` does not import
+jax, so jax-free tooling (``bench.regress``, receipt validation in CI)
+can reach :mod:`.receipt` without initializing a backend.
 """
 
-from pytorch_distributed_training_tutorials_tpu.obs.metrics import (  # noqa: F401
-    MetricsLogger,
-)
-from pytorch_distributed_training_tutorials_tpu.obs.trace import (  # noqa: F401
-    StepReport,
-    classify_hlo,
-)
-from pytorch_distributed_training_tutorials_tpu.obs.timing import (  # noqa: F401
-    BracketResult,
-    DriftBracket,
-    LaunchFit,
-    MinOfN,
-    TimingResult,
-    launch_overhead_fit,
-)
-from pytorch_distributed_training_tutorials_tpu.obs.receipt import (  # noqa: F401
-    KINDS,
-    SCHEMA,
-    environment_stamp,
-    load_receipt,
-    make_receipt,
-    validate_receipt,
-    write_receipt,
-)
+import importlib
+
+# name -> submodule; resolved on first access via __getattr__.
+_LAZY_EXPORTS = {
+    "MetricsLogger": "pytorch_distributed_training_tutorials_tpu.obs.metrics",
+    "StepReport": "pytorch_distributed_training_tutorials_tpu.obs.trace",
+    "classify_hlo": "pytorch_distributed_training_tutorials_tpu.obs.trace",
+    "BracketResult": "pytorch_distributed_training_tutorials_tpu.obs.timing",
+    "DriftBracket": "pytorch_distributed_training_tutorials_tpu.obs.timing",
+    "LaunchFit": "pytorch_distributed_training_tutorials_tpu.obs.timing",
+    "MinOfN": "pytorch_distributed_training_tutorials_tpu.obs.timing",
+    "TimingResult": "pytorch_distributed_training_tutorials_tpu.obs.timing",
+    "launch_overhead_fit": "pytorch_distributed_training_tutorials_tpu.obs.timing",
+    "KINDS": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
+    "SCHEMA": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
+    "environment_stamp": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
+    "load_receipt": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
+    "make_receipt": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
+    "validate_receipt": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
+    "write_receipt": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
+}
+
+__all__ = sorted(_LAZY_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module_name = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))

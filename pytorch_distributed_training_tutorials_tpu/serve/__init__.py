@@ -11,33 +11,56 @@ Public surface:
   the host-side queue and its backpressure signal;
 - :func:`.slots.bucket_len` / :func:`.slots.init_slot_state` /
   :func:`.slots.write_slot` — the slot-state building blocks (exposed
-  for tests and for engines over non-TransformerLM models).
+  for tests and for engines over non-TransformerLM models);
+- :class:`.prefix.PrefixIndex` / :class:`.prefix.Segment` — the
+  jax-free radix prefix index behind ``ServeEngine(prefix_cache_bytes=
+  ...)``: shared-prompt KV reuse via retained cache segments
+  (longest-prefix-match, refcount pinning, LRU byte budget).
 
 ``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` runs the end-to-end smoke
 (token-exactness vs ``generate()`` included) and prints one receipt line
 — tier-1 wires it in via tests/test_serve.py.
+
+The re-exports below are PEP 562 LAZY (same pattern as obs/ and bench/):
+the host-only halves (:mod:`.scheduler`, :mod:`.prefix`) must stay
+importable without initializing a backend — tests/test_prefix.py pins it
+in a subprocess — and an eager ``from .engine import ...`` here would
+drag jax into every ``import ...serve.prefix``.
 """
 
-from pytorch_distributed_training_tutorials_tpu.serve.engine import ServeEngine
-from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
-    Completion,
-    FifoScheduler,
-    QueueFull,
-    Request,
-)
-from pytorch_distributed_training_tutorials_tpu.serve.slots import (
-    bucket_len,
-    init_slot_state,
-    write_slot,
-)
+import importlib
 
-__all__ = [
-    "Completion",
-    "FifoScheduler",
-    "QueueFull",
-    "Request",
-    "ServeEngine",
-    "bucket_len",
-    "init_slot_state",
-    "write_slot",
-]
+# name -> submodule; resolved on first access via __getattr__.
+_LAZY_EXPORTS = {
+    "ServeEngine": "pytorch_distributed_training_tutorials_tpu.serve.engine",
+    "PrefixIndex": "pytorch_distributed_training_tutorials_tpu.serve.prefix",
+    "Segment": "pytorch_distributed_training_tutorials_tpu.serve.prefix",
+    "Completion": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
+    "FifoScheduler": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
+    "QueueFull": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
+    "Request": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
+    "bucket_len": "pytorch_distributed_training_tutorials_tpu.serve.slots",
+    "extract_segment": "pytorch_distributed_training_tutorials_tpu.serve.slots",
+    "init_slot_state": "pytorch_distributed_training_tutorials_tpu.serve.slots",
+    "seed_cache": "pytorch_distributed_training_tutorials_tpu.serve.slots",
+    "tree_nbytes": "pytorch_distributed_training_tutorials_tpu.serve.slots",
+    "write_slot": "pytorch_distributed_training_tutorials_tpu.serve.slots",
+}
+
+__all__ = sorted(_LAZY_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module_name = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))

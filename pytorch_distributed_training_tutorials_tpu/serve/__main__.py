@@ -10,8 +10,13 @@ continuous-batching machinery (slot refill, bucketed prefill, per-slot
 positions, chained decode) must be invisible in the outputs. Also pins
 backpressure (:class:`..serve.QueueFull`) and the fetch discipline (at
 most one ``jax.device_get`` per decode chain, counted by monkeypatching).
-Prints exactly one JSON line (a ``graft-receipt/v1`` envelope) and exits
-non-zero on any failure.
+A second arm replays an overlapping-prompt stream (one shared prefix
+family, per-request tails) through two engines — prefix cache OFF and ON
+(``prefix_cache_bytes``) — and requires byte-identical greedy tokens, a
+hit rate > 0, FEWER full prefills (splices replace them, counted not
+estimated), and the same one-fetch-per-chain budget with splices
+included. Prints exactly one JSON line (a ``graft-receipt/v1`` envelope)
+and exits non-zero on any failure.
 """
 
 from __future__ import annotations
@@ -119,6 +124,82 @@ def selftest(json_path: str | None = None) -> dict:
                 f"request {rid}: engine {completions[rid].tokens} != "
                 f"generate {ref}"
             )
+    # ------------------------------------------------------------------
+    # prefix-cache arm: one shared prefix family, per-request tails;
+    # cache ON must match cache OFF byte-for-byte while replacing full
+    # prefills with splices (counted, not estimated)
+    # ------------------------------------------------------------------
+    rng, sub = jax.random.split(rng)
+    shared = jax.device_get(
+        jax.random.randint(sub, (16,), 0, cfg.vocab_size)
+    ).tolist()
+    overlap_reqs = []
+    for i, (tail_len, max_new) in enumerate(
+        [(3, 8), (5, 6), (2, 10), (4, 7), (3, 5), (6, 9)]
+    ):
+        rng, sub = jax.random.split(rng)
+        tail = jax.device_get(
+            jax.random.randint(sub, (tail_len,), 0, cfg.vocab_size)
+        ).tolist()
+        overlap_reqs.append((shared + tail, max_new))
+
+    def run_stream(prefix_cache_bytes):
+        eng = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=8,
+            prefix_cache_bytes=prefix_cache_bytes,
+        )
+        count = {"n": 0}
+
+        def counting(x):
+            count["n"] += 1
+            return real_get(x)
+
+        jax.device_get = counting
+        try:
+            out = {}
+            pending = list(overlap_reqs)
+            for toks, max_new in pending[:2]:
+                eng.submit(Request(prompt=toks, max_new_tokens=max_new))
+            pending = pending[2:]
+            while not eng.idle or pending:
+                while pending:
+                    toks, max_new = pending[0]
+                    try:
+                        eng.submit(
+                            Request(prompt=toks, max_new_tokens=max_new)
+                        )
+                        pending.pop(0)
+                    except QueueFull:
+                        break
+                for c in eng.step():
+                    out[c.request_id] = c.tokens
+        finally:
+            jax.device_get = real_get
+        return eng, out, count["n"]
+
+    eng_off, toks_off, _ = run_stream(0)
+    eng_on, toks_on, fetches_on = run_stream(16 * 1024 * 1024)
+    stats = eng_on.prefix_stats()
+    prefix_exact = toks_on == toks_off
+    if not prefix_exact:
+        problems.append(
+            f"prefix cache changed greedy tokens: {toks_on} != {toks_off}"
+        )
+    if stats.get("prefix_hit_rate", 0) <= 0 or eng_on.n_splices < 1:
+        problems.append(f"no prefix hits on an overlapping stream: {stats}")
+    if eng_on.n_prefills >= eng_off.n_prefills:
+        problems.append(
+            f"prefix cache saved no prefills: {eng_on.n_prefills} on vs "
+            f"{eng_off.n_prefills} off"
+        )
+    on_budget = eng_on.n_chains + eng_on.n_prefills + eng_on.n_splices
+    if fetches_on > on_budget:
+        problems.append(
+            f"prefix arm: {fetches_on} host fetches > {on_budget} "
+            f"({eng_on.n_chains} chains + {eng_on.n_prefills} prefills + "
+            f"{eng_on.n_splices} splices)"
+        )
+
     receipt = make_receipt(
         "serve_selftest",
         {
@@ -131,6 +212,11 @@ def selftest(json_path: str | None = None) -> dict:
             "generated_tokens": engine.generated_tokens,
             "token_exact_mismatches": mismatches,
             "backpressure_seen": backpressured,
+            "prefix_requests": len(overlap_reqs),
+            "prefix_token_exact": prefix_exact,
+            "prefix_prefills_off": eng_off.n_prefills,
+            "prefix_prefills_on": eng_on.n_prefills,
+            **stats,
             "problems": problems,
             "ok": not problems,
         },

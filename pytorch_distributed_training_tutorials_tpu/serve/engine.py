@@ -22,6 +22,19 @@ Orca-style (OSDI '22) fix, built TPU-native:
   (bucketed prompt lengths, :func:`.slots.bucket_len`; splice + position
   reset, :func:`.slots.write_slot`) — no recompile per request, per
   prompt length (beyond the bucket set), or per slot;
+- with ``prefix_cache_bytes > 0``, refill first consults a host-side
+  radix index (:class:`.prefix.PrefixIndex`, the vLLM SOSP '23
+  shared-prefix idea rebuilt for fixed shapes): a longest-prefix-match
+  seeds the slot from a RETAINED device cache segment
+  (:func:`.slots.seed_cache` + the same ``write_slot`` surgery) and
+  prefills only the uncached suffix through the decode path's chunked
+  continuation (``models/transformer.py`` ``_store_decode_kv``) — a
+  deep hit turns an O(prompt) prefill into an O(suffix) one. Segment
+  and suffix lengths reuse the pow2 bucket set, so the prefix cache
+  adds a bounded set of compiles, and greedy token-exactness is
+  preserved BITWISE for full-precision caches
+  (tests/test_transformer.py pins the chunk-vs-prefill equality,
+  tests/test_serve.py the end-to-end cache-on-vs-off stream);
 - sampling is the SAME pipeline ``generate()`` uses
   (:mod:`..models.sampling`), vmapped over per-slot PRNG streams: a
   request's draws depend only on its own ``seed`` and draw index, never
@@ -45,6 +58,7 @@ from pytorch_distributed_training_tutorials_tpu.models.sampling import (
     sample_logits,
     sample_logits_per_slot,
 )
+from pytorch_distributed_training_tutorials_tpu.serve.prefix import PrefixIndex
 from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
     Completion,
     FifoScheduler,
@@ -52,20 +66,27 @@ from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
 )
 from pytorch_distributed_training_tutorials_tpu.serve.slots import (
     bucket_len,
+    extract_segment,
     init_slot_state,
+    seed_cache,
+    tree_nbytes,
     write_slot,
 )
 
 
 class _Active:
-    """Host-side view of one occupied slot."""
+    """Host-side view of one occupied slot. ``segment`` pins the prefix
+    segment this slot was spliced from (released at completion);
+    ``ttft_s`` is submit-to-first-token wall time."""
 
-    __slots__ = ("request", "tokens", "remaining")
+    __slots__ = ("request", "tokens", "remaining", "segment", "ttft_s")
 
     def __init__(self, request: Request, first_token: int):
         self.request = request
         self.tokens = [first_token]
         self.remaining = request.max_new_tokens - 1
+        self.segment = None
+        self.ttft_s = 0.0
 
 
 class ServeEngine:
@@ -97,6 +118,8 @@ class ServeEngine:
         temperature: float = 0.0,
         top_k: int = 0,
         top_p: float = 1.0,
+        prefix_cache_bytes: int = 0,
+        min_hit_depth: int = 1,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -114,9 +137,28 @@ class ServeEngine:
         self._temperature = float(temperature)
         self._top_k = int(top_k)
         self._top_p = float(top_p)
+        # prefix cache: 0 bytes = off (the engine is then byte-identical
+        # in behavior to the pre-prefix-cache one)
+        self._retain = prefix_cache_bytes > 0
+        self.prefix = (
+            PrefixIndex(prefix_cache_bytes) if self._retain else None
+        )
+        self._min_hit_depth = int(min_hit_depth)
+        if self._retain:
+            # shape/dtype proto of the batch-1 decode cache — seed_cache
+            # builds the splice start state from it (eval_shape: no FLOPs,
+            # no buffers)
+            self._proto1 = jax.eval_shape(
+                lambda p, t: self.model.apply(
+                    {"params": p}, t, decode=True, mutable=["cache"]
+                )[1]["cache"],
+                params, jnp.zeros((1, 1), jnp.int32),
+            )
         # stats for receipts
         self.n_prefills = 0
         self.n_chains = 0
+        self.n_splices = 0
+        self.prefix_hit_tokens = 0
         self.generated_tokens = 0
         # donating the state tree lets XLA update the multi-hundred-MB
         # cache in place; CPU jit warns on donation (unsupported), so
@@ -124,6 +166,16 @@ class ServeEngine:
         donate = (1,) if jax.default_backend() in ("tpu", "gpu") else ()
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
         self._chain = jax.jit(self._chain_fn, donate_argnums=donate)
+        # splice: same donation as prefill (state is arg 1); the retained
+        # segment (arg 2) must NEVER be donated — the index keeps serving
+        # it to later requests. The two compile statics are keyword-only,
+        # by NAME: for a jitted BOUND method argnums exclude self (unlike
+        # the nn.remat(Block, static_argnums=...) idiom which counts it),
+        # and names are unambiguous under both conventions.
+        self._splice = jax.jit(
+            self._splice_fn, static_argnames=("seg_len", "grow"),
+            donate_argnums=donate,
+        )
         self._park = jax.jit(
             _park_slot, donate_argnums=(0,) if donate else ()
         )
@@ -139,7 +191,13 @@ class ServeEngine:
         token is sampled from the logits gathered at the last REAL prompt
         position, and the slot's counters reset. All of ``p_len`` /
         ``slot`` / ``seed`` / ``max_new`` are traced scalars — one
-        compile per prompt BUCKET, not per request."""
+        compile per prompt BUCKET, not per request.
+
+        With the prefix cache on, the bucket-length leading chunk of the
+        just-prefilled batch-1 cache rides out as a retained segment
+        (:func:`.slots.extract_segment` — insert-on-prefill); ``()``
+        otherwise, so the cache-off engine's compiled program is
+        unchanged."""
         logits, upd = self.model.apply(
             {"params": params}, tokens, prefill=True, mutable=["cache"],
             last_pos=p_len - 1,
@@ -152,6 +210,13 @@ class ServeEngine:
         cache = write_slot(
             state["cache"], upd["cache"], slot, p_len, self._scan_layers
         )
+        seg = (
+            extract_segment(
+                upd["cache"], tokens.shape[1], self._scan_layers
+            )
+            if self._retain
+            else ()
+        )
         state = {
             "cache": cache,
             "last_tok": state["last_tok"].at[slot].set(first[0]),
@@ -159,7 +224,51 @@ class ServeEngine:
             # the first generated token is already accounted for
             "remaining": state["remaining"].at[slot].set(max_new - 1),
         }
-        return state, first[0]
+        return state, first[0], seg
+
+    def _splice_fn(self, params, state, segment, suffix, depth, p_len,
+                   slot, seed, max_new, *, seg_len, grow):
+        """Prefix-cache-hit refill: seed a batch-1 cache from a retained
+        ``segment`` at ``depth`` reused positions, run ONE chunked decode
+        over the bucket-padded ``suffix`` (1, s_bucket) — the suffix
+        prefill, same math as batched prefill (models/transformer.py
+        decode S>1; bit-equal for full-precision caches,
+        tests/test_transformer.py) — then splice the result into
+        ``slot`` exactly like :meth:`_prefill_fn` does. The first token
+        samples from the logits at the last REAL suffix token
+        (``last_pos = p_len - 1 - depth``, local), so a hit is
+        token-identical to a full prefill.
+
+        ``seg_len`` / ``grow`` are STATIC: segment + suffix lengths come
+        from the pow2 bucket set, so compiles stay bounded by (segment
+        bucket, suffix bucket, grow) triples, never per request. With
+        ``grow`` the full-prompt segment rides out for insertion —
+        multi-turn streams deepen the index one splice at a time."""
+        cache1 = seed_cache(self._proto1, segment, depth)
+        logits, upd = self.model.apply(
+            {"params": params, "cache": cache1}, suffix, decode=True,
+            mutable=["cache"], last_pos=p_len - 1 - depth,
+        )
+        key = jax.random.PRNGKey(seed)
+        first, key = sample_logits(
+            logits[:, -1].astype(jnp.float32), key,
+            self._temperature, self._top_k, self._top_p,
+        )
+        cache = write_slot(
+            state["cache"], upd["cache"], slot, p_len, self._scan_layers
+        )
+        seg = (
+            extract_segment(upd["cache"], seg_len, self._scan_layers)
+            if grow
+            else ()
+        )
+        state = {
+            "cache": cache,
+            "last_tok": state["last_tok"].at[slot].set(first[0]),
+            "keys": state["keys"].at[slot].set(key),
+            "remaining": state["remaining"].at[slot].set(max_new - 1),
+        }
+        return state, first[0], seg
 
     def _chain_fn(self, params, state):
         """``tokens_per_launch`` decode steps as one ``lax.scan`` — one
@@ -251,20 +360,57 @@ class ServeEngine:
     def _refill(self, slot: int, req: Request) -> list[Completion]:
         """Prefill ``req`` into ``slot``. One launch + one scalar fetch
         (the first sampled token — needed host-side for EOS/max_new==1
-        admission into the decode phase)."""
+        admission into the decode phase).
+
+        With the prefix cache on, a longest-prefix-match against the
+        radix index turns the full prefill into a segment splice + a
+        prefill over only the uncached suffix (:meth:`_splice_fn`) —
+        still one launch + one scalar fetch. Either way the prompt's own
+        prefix is inserted into the index (when not already resident),
+        and a hit pins its donor segment until this request completes,
+        so eviction only ever happens here, BETWEEN decode chains, and
+        never under a slot mid-decode."""
         prompt = [int(t) for t in req.prompt]
         p_len = len(prompt)
         bucket = bucket_len(p_len, self.window)
-        padded = prompt + [0] * (bucket - p_len)
-        tokens = jnp.asarray([padded], jnp.int32)
-        self._state, first = self._prefill(
-            self.params, self._state, tokens, p_len, slot, req.seed,
-            req.max_new_tokens,
+        hit = (
+            self.prefix.lookup(prompt, self._min_hit_depth)
+            if self.prefix is not None
+            else None
         )
-        self.n_prefills += 1
+        grow = self.prefix is not None and tuple(prompt) not in self.prefix
+        if hit is not None:
+            depth, segment = hit
+            suffix = prompt[depth:]
+            s_bucket = bucket_len(len(suffix), self.window)
+            tokens = jnp.asarray(
+                [suffix + [0] * (s_bucket - len(suffix))], jnp.int32
+            )
+            self.prefix.acquire(segment)
+            self._state, first, new_seg = self._splice(
+                self.params, self._state, segment.handle, tokens, depth,
+                p_len, slot, req.seed, req.max_new_tokens,
+                seg_len=bucket, grow=grow,
+            )
+            self.n_splices += 1
+            self.prefix_hit_tokens += depth
+        else:
+            segment = None
+            padded = prompt + [0] * (bucket - p_len)
+            tokens = jnp.asarray([padded], jnp.int32)
+            self._state, first, new_seg = self._prefill(
+                self.params, self._state, tokens, p_len, slot, req.seed,
+                req.max_new_tokens,
+            )
+            self.n_prefills += 1
+        if grow:
+            self.prefix.insert(tuple(prompt), new_seg, tree_nbytes(new_seg))
         first = int(jax.device_get(first))
         self.generated_tokens += 1
         act = _Active(req, first)
+        act.ttft_s = time.perf_counter() - req.submitted_s
+        if segment is not None:
+            act.segment = segment
         if req.max_new_tokens == 1 or first == req.eos_token:
             reason = "eos" if first == req.eos_token else "length"
             if act.remaining > 0:
@@ -307,13 +453,35 @@ class ServeEngine:
         return done
 
     def _complete(self, act: _Active, reason: str) -> Completion:
+        if act.segment is not None:
+            # the slot no longer decodes from this segment's splice;
+            # unpin it (it stays resident + hot for the next hit)
+            self.prefix.release(act.segment)
+            act.segment = None
         return Completion(
             request_id=act.request.request_id,
             prompt=[int(t) for t in act.request.prompt],
             tokens=act.tokens,
             finish_reason=reason,
             latency_s=time.perf_counter() - act.request.submitted_s,
+            ttft_s=act.ttft_s,
         )
+
+    def prefix_stats(self) -> dict[str, int | float]:
+        """Prefix-cache counters for the serving receipt: index stats
+        (segments / used+evicted bytes / hits / misses) plus the engine's
+        splice count, reused-token total, and the resulting hit rate.
+        All host bookkeeping — reading them costs no device fetch."""
+        if self.prefix is None:
+            return {"prefix_cache": 0}
+        looked = self.prefix.hits + self.prefix.misses
+        return {
+            "prefix_cache": 1,
+            **{f"prefix_{k}": v for k, v in self.prefix.stats().items()},
+            "prefix_hit_rate": self.prefix.hits / max(1, looked),
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "n_splices": self.n_splices,
+        }
 
 
 def _park_slot(remaining, slot):

@@ -50,13 +50,16 @@ class Request:
 class Completion:
     """A finished request: ``tokens`` are the generated ids (prompt
     excluded, stop token included when ``finish_reason == "eos"``);
-    ``latency_s`` is submit-to-completion wall time."""
+    ``latency_s`` is submit-to-completion wall time and ``ttft_s``
+    submit-to-first-token (the prefill/splice fetch) — the pair the
+    serving receipt reports as p50/p95."""
 
     request_id: int
     prompt: list[int]
     tokens: list[int]
     finish_reason: str  # "length" | "eos"
     latency_s: float
+    ttft_s: float = 0.0
 
 
 class FifoScheduler:

@@ -22,10 +22,20 @@ Three pieces live here:
   splices a batch-1 prefill cache into slot ``s`` of the big cache and
   resets that slot's position counter, inside whatever jit it is called
   from (slot index and prompt length are traced scalars — no recompile
-  per slot or per length).
+  per slot or per length);
+- :func:`extract_segment` / :func:`seed_cache` / :func:`tree_nbytes` —
+  the device half of the prefix cache (:mod:`.prefix`): cut a retained
+  prefix segment out of a batch-1 prefilled cache (static bucket length
+  on the sequence axis, so segment shapes reuse the pow2 bucket set and
+  splices never recompile per prompt), seed a fresh batch-1 cache from
+  one, and size a segment host-side from leaf metadata (no device
+  fetch — the index's byte accounting must not break the engine's
+  one-fetch-per-chain budget).
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -122,6 +132,70 @@ def write_slot(cache, prefill_cache, slot, p_len, scan_layers: bool):
         )
 
     return jax.tree_util.tree_map_with_path(upd, cache, prefill_cache)
+
+
+def extract_segment(cache, seg_len: int, scan_layers: bool):
+    """Cut the first ``seg_len`` sequence positions out of a batch-1
+    prefilled ``cache`` tree — the retained prefix segment the radix
+    index (:mod:`.prefix`) keeps alive.
+
+    ``seg_len`` is STATIC (a pow2 ``bucket_len`` of the prefix length):
+    segment shapes come from the same bucket set prefill compiles
+    against, so a splice over any retained segment hits an existing
+    compile instead of minting one per prompt length. The sequence axis
+    is 1, or 2 under ``scan_layers`` (leading layer axis) — same layout
+    rule as :func:`write_slot`. ``cache_index`` leaves pass through
+    untouched; their value is dead weight (a handful of int32s) that
+    :func:`seed_cache` overwrites with the matched depth. Positions in
+    ``[real prefix, seg_len)`` hold bucket-padding garbage — safe because
+    a consumer only reuses ``[0, depth)`` with ``depth <= real prefix``
+    and overwrites/masks everything beyond (see :mod:`.prefix`)."""
+    ax = 2 if scan_layers else 1
+
+    def cut(path, leaf):
+        if _leaf_name(path) == "cache_index":
+            return leaf
+        sl = [slice(None)] * leaf.ndim
+        sl[ax] = slice(0, seg_len)
+        return leaf[tuple(sl)]
+
+    return jax.tree_util.tree_map_with_path(cut, cache)
+
+
+def seed_cache(proto, segment, depth):
+    """Build a batch-1 full-window cache whose ``[0, seg_len)`` positions
+    come from a retained ``segment`` and whose position counters read
+    ``depth`` — the device-side start state of a prefix-cache hit: the
+    suffix prefill then continues from position ``depth`` exactly as if
+    positions ``[0, depth)`` had just been prefilled (bit-equal for
+    full-precision caches, tests/test_transformer.py pins it).
+
+    ``proto`` is a shape/dtype pytree of the batch-1 decode cache (the
+    engine evals it once at construction); ``depth`` may be traced. The
+    segment lands at the tree origin (it IS the leading seq chunk, on
+    every layout — unrolled, scanned, int8 scales), so one origin
+    ``dynamic_update_slice`` per leaf covers all of them."""
+
+    def seed(path, p, seg):
+        if _leaf_name(path) == "cache_index":
+            return jnp.full(p.shape, depth, jnp.int32)
+        z = jnp.zeros(p.shape, p.dtype)
+        return jax.lax.dynamic_update_slice(
+            z, seg.astype(p.dtype), (0,) * z.ndim
+        )
+
+    return jax.tree_util.tree_map_with_path(seed, proto, segment)
+
+
+def tree_nbytes(tree) -> int:
+    """Total bytes of a pytree's array leaves, from shape/dtype metadata
+    only — works on concrete arrays AND ``jax.eval_shape`` structs, and
+    never touches the device (the prefix index budgets bytes without
+    spending a host fetch)."""
+    return sum(
+        math.prod(leaf.shape) * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
 
 
 def _leaf_name(path) -> str:

@@ -1,0 +1,156 @@
+"""bench/regress.py: the receipt-trajectory regression gate.
+
+Pure host code — regress must never import jax (it runs as a gate in
+environments with no backend), and the synthetic-receipt smoke is
+deterministic: a fabricated improving trajectory passes, a decaying one
+fails with exit 1, and the gate's config fingerprinting refuses to
+compare receipts from different experiments. The final test IS the
+standing gate: the repo's own checked-in receipts must be
+regression-free at the default tolerance.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.bench import regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, name, payload):
+    p = tmp_path / name
+    p.write_text(json.dumps(payload))
+    return str(p)
+
+
+SERVING_CFG = {"preset": "1b", "batch": 4, "prompt_len": 2048}
+
+
+def test_improving_trajectory_passes(tmp_path, capsys):
+    _write(tmp_path, "SERVING_r01.json",
+           {**SERVING_CFG, "decode_tok_per_s": 100.0})
+    _write(tmp_path, "SERVING_r02.json",
+           {**SERVING_CFG, "decode_tok_per_s": 140.0})
+    assert regress.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "r01 100" in out and "r02 140" in out
+    assert "REGRESSION" not in out
+
+
+def test_regression_fails_beyond_tolerance(tmp_path, capsys):
+    _write(tmp_path, "SERVING_r01.json",
+           {**SERVING_CFG, "decode_tok_per_s": 100.0})
+    _write(tmp_path, "SERVING_r02.json",
+           {**SERVING_CFG, "decode_tok_per_s": 80.0})
+    assert regress.main([str(tmp_path)]) == 1
+    assert "REGRESSION" in capsys.readouterr().out
+    # a 20% drop is fine under a 25% tolerance
+    assert regress.main([str(tmp_path), "--tolerance", "0.25"]) == 0
+
+
+def test_latest_vs_best_not_vs_previous(tmp_path):
+    """The gate compares the newest round against the BEST earlier one —
+    a slow decay ending below the historic peak still fails even if each
+    consecutive step is inside tolerance."""
+    for i, v in enumerate([100.0, 97.0, 94.0], start=1):
+        _write(tmp_path, f"SERVING_r0{i}.json",
+               {**SERVING_CFG, "decode_tok_per_s": v})
+    assert regress.main([str(tmp_path), "--tolerance", "0.05"]) == 1
+    assert regress.main([str(tmp_path), "--tolerance", "0.07"]) == 0
+
+
+def test_different_configs_never_compared(tmp_path):
+    """An int8 round after an f32 round is a different experiment, not a
+    regression — config fingerprints split the trajectory."""
+    _write(tmp_path, "SERVING_r01.json",
+           {**SERVING_CFG, "decode_tok_per_s": 500.0})
+    _write(tmp_path, "SERVING_r02.json",
+           {**SERVING_CFG, "kv_cache_dtype": "int8",
+            "decode_tok_per_s": 100.0})
+    assert regress.main([str(tmp_path)]) == 0
+
+
+def test_mfu_gated_and_schemad_receipts_participate(tmp_path):
+    """Schema'd graft-receipt/v1 envelopes group with legacy rounds of
+    the same kind + config (the envelope keys are not config)."""
+    _write(tmp_path, "TRAIN_LLM_r05.json",
+           {"preset": "760m", "batch": 2, "seq": 2048, "mfu": 0.52,
+            "tokens_per_s": 15000})
+    _write(tmp_path, "TRAIN_LLM_r06.json", {
+        "schema": "graft-receipt/v1", "kind": "lm_headline",
+        "env": {"jax_version": "0", "backend": "cpu", "device_count": 1},
+        "preset": "760m", "batch": 2, "seq": 2048, "mfu": 0.40,
+        "tokens_per_s": 16000,
+    })
+    # kinds differ (legacy infers "train" from the filename, the schema'd
+    # one declares lm_headline) -> no comparison across the rename...
+    assert regress.main([str(tmp_path)]) == 0
+    # ...but within one declared kind the MFU drop trips the gate
+    _write(tmp_path, "TRAIN_LLM_r07.json", {
+        "schema": "graft-receipt/v1", "kind": "lm_headline",
+        "env": {"jax_version": "0", "backend": "cpu", "device_count": 1},
+        "preset": "760m", "batch": 2, "seq": 2048, "mfu": 0.30,
+        "tokens_per_s": 16500,
+    })
+    assert regress.main([str(tmp_path)]) == 1
+
+
+def test_bench_value_gated_only_when_unit_is_rate(tmp_path):
+    _write(tmp_path, "BENCH_r01.json",
+           {"parsed": {"metric": "m", "value": 100.0, "unit": "images/sec"}})
+    _write(tmp_path, "BENCH_r02.json",
+           {"parsed": {"metric": "m", "value": 50.0, "unit": "images/sec"}})
+    assert regress.main([str(tmp_path)]) == 1
+    # a non-rate "value" (e.g. an accuracy) is not a throughput gate
+    _write(tmp_path, "ACC_r01.json", {"metric": "acc", "value": 0.99,
+                                      "unit": "fraction"})
+    _write(tmp_path, "ACC_r02.json", {"metric": "acc", "value": 0.50,
+                                      "unit": "fraction"})
+    assert regress.main([str(tmp_path), "--json"]) == 1  # BENCH still fails
+    _write(tmp_path, "BENCH_r02.json",
+           {"parsed": {"metric": "m", "value": 101.0, "unit": "images/sec"}})
+    assert regress.main([str(tmp_path)]) == 0  # ACC pair alone gates nothing
+
+
+def test_bad_tolerance_is_usage_error(tmp_path):
+    assert regress.main([str(tmp_path), "--tolerance", "1.5"]) == 2
+
+
+def test_checked_in_receipts_are_regression_free():
+    """The standing gate: the repo's own receipt history must pass. A
+    session that checks in a slower round either explains it (new config
+    fields -> new fingerprint) or fixes it."""
+    assert regress.main([REPO]) == 0
+
+
+def test_regress_cli_imports_no_jax():
+    """regress is a gate for jax-less environments too (same discipline
+    test_static_analysis pins for the analysis CLI)."""
+    code = (
+        "import sys\n"
+        "from pytorch_distributed_training_tutorials_tpu.bench import regress\n"
+        "assert 'jax' not in sys.modules, 'regress must not import jax'\n"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONSTARTUP"}
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, cwd=REPO, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+
+
+def test_json_report_shape(tmp_path, capsys):
+    _write(tmp_path, "SERVING_r01.json",
+           {**SERVING_CFG, "decode_tok_per_s": 100.0})
+    assert regress.main([str(tmp_path), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["n_groups"] == 1 and report["regressions"] == []
+    assert isinstance(report["skipped"], list)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

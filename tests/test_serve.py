@@ -18,6 +18,13 @@ The load-bearing pins:
 - sampled requests are reproducible functions of their OWN seed — the
   same request returns the same tokens no matter what else shares the
   batch (per-slot PRNG streams, models/sampling.py);
+- the radix prefix cache (``prefix_cache_bytes``, ISSUE 6) is INVISIBLE
+  in the tokens: streams with 50–90% shared prefixes are byte-identical
+  greedy cache-on vs cache-off (across the plain, ``scan_layers``, and
+  GQA cache layouts), while full prefills measurably DROP (counted, not
+  estimated — splices replace them), the fetch budget extends by exactly
+  one scalar per splice, and forced LRU eviction under a tiny byte
+  budget changes counters, never tokens;
 - ``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` succeeds in a
   subprocess (the tier-1 wiring for the end-to-end smoke).
 """
@@ -371,6 +378,174 @@ def test_bucketing_reuses_compiles(model_params):
     engine.run_until_idle()
     # jit caches per tokens shape: (1, 8) and (1, 16) only
     assert engine._prefill._cache_size() == 2
+
+
+# ------------------------------------------------------- radix prefix cache
+
+def _overlap_stream(overlap, n_requests=8, lengths=(6, 10, 14), seed=42):
+    """A synthetic shared-prefix stream: request i's prompt is the first
+    ``round(overlap * p_len)`` tokens of ONE shared family plus a random
+    tail — the shared-system-prompt workload the prefix cache targets
+    (the same construction examples/serve_llm_int8.py --prefix-overlap
+    uses)."""
+    import numpy as np
+
+    rng = np.random.Generator(np.random.PCG64(seed))
+    shared = rng.integers(0, CFG.vocab_size, (max(lengths),)).tolist()
+    reqs = []
+    for i in range(n_requests):
+        p_len = lengths[i % len(lengths)]
+        k = min(p_len, int(round(overlap * p_len)))
+        tail = rng.integers(0, CFG.vocab_size, (p_len - k,)).tolist()
+        reqs.append((shared[:k] + tail, 5 + (i % 3)))
+    return reqs
+
+
+def _run_stream(model, params, reqs, **engine_kwargs):
+    """Staggered submit (2 up front, one per scheduling round after) —
+    completions keyed by request id, plus the engine for its counters."""
+    engine = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=8, **engine_kwargs
+    )
+    ids = [
+        engine.submit(Request(prompt=p, max_new_tokens=m, seed=i))
+        for i, (p, m) in enumerate(reqs[:2])
+    ]
+    pending = list(range(2, len(reqs)))
+    completions = {}
+    while not engine.idle or pending:
+        if pending:
+            i = pending.pop(0)
+            p, m = reqs[i]
+            ids.append(engine.submit(Request(prompt=p, max_new_tokens=m,
+                                             seed=i)))
+        for c in engine.step():
+            completions[c.request_id] = c
+    return engine, [completions[rid] for rid in ids]
+
+
+@pytest.mark.parametrize("overlap", [0.5, 0.7, 0.9])
+def test_prefix_cache_token_exact_and_prefills_drop(model_params, overlap):
+    """The ISSUE 6 acceptance pin: on a staggered stream with 50–90%
+    shared prefixes, cache-on output is byte-identical greedy to
+    cache-off, while counted full-prefill launches DROP (splices replace
+    them) and the hit rate is > 0. At 0.7 this is the criterion's
+    synthetic 70%-overlap stream."""
+    model, params = model_params
+    reqs = _overlap_stream(overlap)
+    eng_off, out_off = _run_stream(model, params, reqs)
+    eng_on, out_on = _run_stream(
+        model, params, reqs, prefix_cache_bytes=16 * 1024 * 1024
+    )
+    assert [c.tokens for c in out_on] == [c.tokens for c in out_off]
+    # counted, not estimated: splices replaced full prefills
+    assert eng_on.n_prefills < eng_off.n_prefills
+    assert eng_on.n_splices >= 1
+    assert eng_on.n_prefills + eng_on.n_splices == eng_off.n_prefills
+    stats = eng_on.prefix_stats()
+    assert stats["prefix_hit_rate"] > 0
+    assert stats["prefix_hit_tokens"] > 0
+    # every completion carries a fetch-backed TTFT
+    assert all(c.ttft_s > 0 for c in out_on)
+    # the cache-off engine reports itself off
+    assert eng_off.prefix_stats() == {"prefix_cache": 0}
+
+
+@pytest.mark.parametrize(
+    "cfg_kwargs",
+    [
+        dict(scan_layers=True),
+        dict(n_kv_heads=2),
+    ],
+    ids=["scan_layers", "gqa"],
+)
+def test_prefix_cache_variant_layouts(cfg_kwargs):
+    """Segment extraction / seeding handle the nn.scan-stacked cache
+    (seq axis 2, after the layer axis) and the GQA-shrunk cache: spliced
+    requests stay token-exact vs one-shot generate()."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, **cfg_kwargs)
+    model, params = _make(cfg)
+    reqs = _overlap_stream(0.7, n_requests=6)
+    engine, out = _run_stream(
+        model, params, reqs, prefix_cache_bytes=16 * 1024 * 1024
+    )
+    assert engine.n_splices >= 1  # the splice path actually ran
+    for (prompt, max_new), c in zip(reqs, out):
+        assert c.tokens == _reference(model, params, prompt, max_new)
+
+
+def test_prefix_cache_fetch_budget(model_params, monkeypatch):
+    """A splice costs exactly what a prefill costs on the host side: one
+    scalar fetch for the first sampled token. The whole overlap stream
+    stays inside chains + prefills + splices — no hidden syncs in the
+    index, the acquire/release pinning, or the segment plumbing."""
+    model, params = model_params
+    calls = {"n": 0}
+    real_get = jax.device_get
+    monkeypatch.setattr(
+        jax, "device_get",
+        lambda x: (calls.__setitem__("n", calls["n"] + 1), real_get(x))[1],
+    )
+    engine, out = _run_stream(
+        model, params, _overlap_stream(0.7),
+        prefix_cache_bytes=16 * 1024 * 1024,
+    )
+    assert len(out) == 8 and engine.n_splices >= 1
+    assert calls["n"] == (
+        engine.n_chains + engine.n_prefills + engine.n_splices
+    )
+
+
+def test_prefix_cache_eviction_under_pressure_stays_exact(model_params):
+    """A byte budget too small for the stream's working set forces LRU
+    eviction mid-stream (between chains, by construction — inserts only
+    happen at slot refill): counters move, tokens don't."""
+    from pytorch_distributed_training_tutorials_tpu.serve import tree_nbytes
+
+    model, params = model_params
+    reqs = _overlap_stream(0.5, n_requests=8)
+    eng_off, out_off = _run_stream(model, params, reqs)
+    # size the budget to ~2.5 of the LARGEST segment: a couple of inserts
+    # fit, then every later one must evict a cold resident (at most 2 of
+    # the stream's 8 distinct keys are pinned at once on 2 slots, so an
+    # unpinned victim always exists)
+    longest = max(reqs, key=lambda r: len(r[0]))[0]
+    probe = ServeEngine(
+        model, params, n_slots=1, prefix_cache_bytes=1 << 30
+    )
+    probe.submit(Request(prompt=longest, max_new_tokens=1))
+    probe.run_until_idle()
+    seg_bytes = max(tree_nbytes(s.handle) for s in probe.prefix.segments())
+    eng_on, out_on = _run_stream(
+        model, params, reqs, prefix_cache_bytes=int(seg_bytes * 2.5)
+    )
+    assert [c.tokens for c in out_on] == [c.tokens for c in out_off]
+    assert eng_on.prefix_stats()["prefix_evicted_bytes"] > 0
+
+
+def test_prefix_cache_multi_turn_deepens_the_index(model_params):
+    """The multi-turn shape: each turn's prompt extends the previous
+    prompt + its reply. Turn 2 must splice (not full-prefill) and stay
+    token-exact — grow-on-splice keeps deepening the index."""
+    model, params = model_params
+    turn1 = _prompt(1200, 9)
+    engine = ServeEngine(
+        model, params, n_slots=1, tokens_per_launch=8,
+        prefix_cache_bytes=16 * 1024 * 1024,
+    )
+    rid1 = engine.submit(Request(prompt=turn1, max_new_tokens=6))
+    reply = {c.request_id: c for c in engine.run_until_idle()}[rid1].tokens
+    turn2 = turn1 + reply + _prompt(1201, 4)
+    rid2 = engine.submit(Request(prompt=turn2, max_new_tokens=6))
+    got = {c.request_id: c for c in engine.run_until_idle()}[rid2].tokens
+    assert engine.n_splices == 1 and engine.n_prefills == 1
+    # the hit covered at least the whole first turn's prompt
+    assert engine.prefix_hit_tokens >= len(turn1)
+    assert got == _reference(model, params, turn2, 6)
+    # ...and turn 2's own full prompt is now resident for turn 3
+    assert tuple(turn2) in engine.prefix
 
 
 # ------------------------------------------------------------- the selftest

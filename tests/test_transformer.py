@@ -84,6 +84,92 @@ def test_remat_matches_plain():
     np.testing.assert_allclose(np.asarray(plain), np.asarray(remat_logits), atol=1e-6)
 
 
+@pytest.mark.parametrize(
+    "variant",
+    ["plain", "gqa", "scan"],
+)
+def test_chunked_decode_matches_full_prefill(variant):
+    """Suffix prefill (decode with S>1 from a nonzero cache offset) is the
+    SAME math as one batched prefill: prefill [0, d), then decode the
+    bucket-padded suffix [d, P) in one chunk, and the next-token logits,
+    and every cache row in [0, P), must be BITWISE equal to the full
+    prefill's. This is the exactness contract the serve/ prefix cache
+    leans on (splice a retained segment, prefill only the suffix)."""
+    overrides = {
+        "plain": {},
+        "gqa": {"n_kv_heads": 2},
+        "scan": {"scan_layers": True},
+    }[variant]
+    cfg = TransformerConfig(**{**CFG.__dict__, "max_seq_len": 64, **overrides})
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"
+    ]
+    P, d, pad_to = 13, 5, 16  # suffix 8 real tokens padded to a pow2 bucket
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, P), 0, cfg.vocab_size)
+
+    full, upd_full = model.apply(
+        {"params": params}, tokens, prefill=True, mutable=["cache"],
+        last_pos=P - 1,
+    )
+
+    _, upd = model.apply(
+        {"params": params}, tokens[:, :d], prefill=True, mutable=["cache"],
+        last_pos=d - 1,
+    )
+    suffix = jnp.concatenate(
+        [tokens[:, d:], jnp.zeros((1, pad_to - (P - d)), jnp.int32)], axis=1
+    )
+    chunk, upd_chunk = model.apply(
+        {"params": params, "cache": upd["cache"]}, suffix, decode=True,
+        mutable=["cache"], last_pos=P - 1 - d,
+    )
+
+    assert np.array_equal(np.asarray(full[:, -1]), np.asarray(chunk[:, -1]))
+    seq_axis = 2 if cfg.scan_layers else 1
+    for a, b in zip(
+        jax.tree_util.tree_leaves(upd_full["cache"]),
+        jax.tree_util.tree_leaves(upd_chunk["cache"]),
+    ):
+        if a.ndim <= seq_axis:
+            continue  # cache_index scalars
+        sl = [slice(None)] * a.ndim
+        sl[seq_axis] = slice(0, P)
+        assert np.array_equal(np.asarray(a[tuple(sl)]), np.asarray(b[tuple(sl)]))
+
+
+def test_chunked_decode_int8_kv_argmax_only():
+    """With a reduced-precision cache the suffix chunk attends over the
+    ROUNDED stored K/V while full prefill attends over the unrounded local
+    values (the CLAUDE.md kv_cache_dtype caveat), so bit-exactness is not
+    pinned — only the greedy choice is, on this easy-margin tiny model."""
+    cfg = TransformerConfig(
+        **{**CFG.__dict__, "max_seq_len": 64, "kv_cache_dtype": jnp.int8}
+    )
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"
+    ]
+    P, d = 13, 5
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, P), 0, cfg.vocab_size)
+    full, _ = model.apply(
+        {"params": params}, tokens, prefill=True, mutable=["cache"],
+        last_pos=P - 1,
+    )
+    _, upd = model.apply(
+        {"params": params}, tokens[:, :d], prefill=True, mutable=["cache"],
+        last_pos=d - 1,
+    )
+    suffix = jnp.concatenate([tokens[:, d:], jnp.zeros((1, 8), jnp.int32)], 1)
+    chunk, _ = model.apply(
+        {"params": params, "cache": upd["cache"]}, suffix, decode=True,
+        mutable=["cache"], last_pos=P - 1 - d,
+    )
+    assert np.array_equal(
+        np.asarray(full[:, -1]).argmax(-1), np.asarray(chunk[:, -1]).argmax(-1)
+    )
+
+
 @pytest.mark.slow
 def test_lm_loss_decreases_data_parallel():
     """End-to-end: the bigram dataset is learnable; CE drops well below
