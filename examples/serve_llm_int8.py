@@ -253,6 +253,19 @@ def main():
         "default: 512 when --prefix-overlap > 0, else 0)",
     )
     ap.add_argument(
+        "--spec-k", type=int, default=0, dest="spec_k",
+        help="for --server: self-speculative decoding with k n-gram "
+        "draft tokens per verify step (serve.ServeEngine speculative_k; "
+        "0 disables). Greedy output is token-identical either way; the "
+        "win — fewer sequential decode steps per token — shows on "
+        "templated/repetitive streams, so pair with --prefix-overlap. "
+        "The receipt gains acceptance-rate/verify-forward counters",
+    )
+    ap.add_argument(
+        "--spec-ngram", type=int, default=3, dest="spec_ngram",
+        help="suffix length the n-gram draft matches on (--spec-k)",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -557,6 +570,8 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         top_k=args.top_k,
         top_p=args.top_p,
         prefix_cache_bytes=cache_mb * 1024 * 1024,
+        speculative_k=args.spec_k,
+        spec_ngram=args.spec_ngram,
     )
     rng = np.random.Generator(np.random.PCG64(11))
     # one shared token family: request i's prompt = shared[:k] + tail,
@@ -585,6 +600,8 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     compile_s = time.perf_counter() - t0
     engine.n_chains = engine.n_prefills = engine.generated_tokens = 0
     engine.n_splices = engine.prefix_hit_tokens = 0
+    engine.n_verify_forwards = engine.spec_steps_consumed = 0
+    engine.spec_drafts_accepted = 0
     if engine.prefix is not None:
         engine.prefix.hits = engine.prefix.misses = 0
 
@@ -623,6 +640,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         prefix_overlap=args.prefix_overlap,
         prefix_cache_mb=cache_mb,
         **engine.prefix_stats(),
+        **engine.spec_stats(),
         backend=jax.default_backend(),
     )
     prefix_note = ""
@@ -632,6 +650,13 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
             f", prefix hit rate {st['prefix_hit_rate']:.2f} "
             f"({engine.n_splices} splices, {engine.prefix_hit_tokens} "
             f"tokens reused)"
+        )
+    if args.spec_k:
+        ss = engine.spec_stats()
+        prefix_note += (
+            f", spec-k {args.spec_k}: mean accepted "
+            f"{ss['spec_mean_accepted_len']:.2f}, "
+            f"{ss['n_verify_forwards']} verify forwards for {toks} tokens"
         )
     print(
         f"server: {args.requests} requests (prompts {lengths}, {new} new "

@@ -60,6 +60,12 @@ CONFIG_FIELDS = (
     "seq", "prompt_len", "new_tokens", "max_seq_len", "kv_cache_dtype",
     "tp", "scan_layers", "attn", "n_chips", "n_devices", "temperature",
     "flash_prefill", "prefix_overlap",
+    # speculative decoding: k and the draft n-gram order change what a
+    # tok/s number MEANS (a spec round must never gate — or be gated
+    # by — a non-speculative one); acceptance RATE stays out of the
+    # fingerprint on purpose, it is a workload-dependent outcome, not
+    # part of the configuration
+    "spec_k", "spec_ngram", "speculative",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

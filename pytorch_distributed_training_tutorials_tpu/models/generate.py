@@ -33,7 +33,13 @@ import jax.numpy as jnp
 from pytorch_distributed_training_tutorials_tpu.models.sampling import (
     _NUCLEUS_CANDIDATES,  # noqa: F401  (re-exported: test/caller compat)
     filter_logits,
+    ngram_draft,
     sample_logits,
+    speculative_accept,
+)
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    rewind_cache_index,
+    widen_cache_index,
 )
 
 # The sampling pipeline moved to models/sampling.py so the continuous-
@@ -92,6 +98,89 @@ def _compiled_generate(
     return run
 
 
+@functools.lru_cache(maxsize=64)
+def _compiled_spec_generate(
+    model, p_len: int, total: int, temperature: float,
+    top_k: int, top_p: float, k: int, ngram: int,
+):
+    """Self-speculative twin of :func:`_compiled_generate`: the one-shot
+    mirror of the serving engine's speculate-k chain
+    (``serve/engine.py`` ``_spec_chain_fn``), so engine-vs-generate
+    parity tests cover speculation too.
+
+    Each loop iteration drafts ``k`` tokens per row from the tokens
+    array itself (it IS the history buffer —
+    :func:`..models.sampling.ngram_draft` masks by the per-row count
+    ``t``), verifies ``[last, drafts]`` in one (B, k+1) decode forward,
+    accepts via :func:`..models.sampling.speculative_accept`, rewinds
+    the rejected cache positions
+    (:func:`..models.transformer.rewind_cache_index`; the prefill-built
+    scalar counters are widened to per-row vectors first since rows
+    advance at different rates), and scatters the accepted block with
+    out-of-budget writes clamped out (``mode="drop"``). The trip count
+    is data-dependent, so this is a ``lax.while_loop`` — rows that hit
+    ``total`` emit 0 and park while stragglers finish; active rows
+    always emit >= 1, so the loop terminates."""
+
+    @jax.jit
+    def run(params, tokens, key):
+        b = tokens.shape[0]
+        rows = jnp.arange(b)
+        offs = jnp.arange(k + 1)
+        logits, upd = model.apply(
+            {"params": params},
+            tokens[:, :p_len],
+            prefill=True,
+            mutable=["cache"],
+        )
+        first, key = sample_logits(
+            logits[:, -1].astype(jnp.float32), key,
+            temperature, top_k, top_p,
+        )
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, first[:, None], (0, p_len)
+        )
+        cache = widen_cache_index(upd["cache"], b)
+        keys = jax.random.split(key, b)
+        t0 = jnp.full((b,), p_len + 1, jnp.int32)
+
+        def cond(carry):
+            return jnp.any(carry[3] < total)
+
+        def body(carry):
+            cache, tokens, keys, t = carry
+            active = t < total
+            last = tokens[rows, t - 1]
+            draft = ngram_draft(tokens, t, k, ngram)
+            toks_in = jnp.concatenate([last[:, None], draft], axis=1)
+            lg, upd = model.apply(
+                {"params": params, "cache": cache}, toks_in,
+                decode=True, mutable=["cache"],
+            )
+            emitted, n_acc, keys = speculative_accept(
+                lg.astype(jnp.float32), draft, keys,
+                temperature, top_k, top_p,
+            )
+            cache = rewind_cache_index(upd["cache"], k - n_acc)
+            n_emit = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+            cols = jnp.where(
+                offs[None, :] < n_emit[:, None],
+                t[:, None] + offs[None, :], total,
+            )
+            tokens = tokens.at[rows[:, None], cols].set(
+                emitted, mode="drop"
+            )
+            t = jnp.minimum(t + n_emit, total)
+            return (cache, tokens, keys, t)
+
+        _, tokens, _, _ = jax.lax.while_loop(
+            cond, body, (cache, tokens, keys, t0)
+        )
+        return tokens
+
+    return run
+
+
 def generate(
     model,
     params,
@@ -102,6 +191,8 @@ def generate(
     top_k: int = 0,
     top_p: float = 1.0,
     rng: jax.Array | None = None,
+    speculative_k: int = 0,
+    spec_ngram: int = 3,
 ):
     """Generate ``max_new_tokens`` continuations of ``prompt``.
 
@@ -123,9 +214,20 @@ def generate(
     tokens; a flatter distribution (e.g. high temperature over an
     untrained model) degrades to an implicit additional top-1024 cut.
     ``top_k=1`` reduces to greedy up to exact logit ties (a tie keeps
-    both tokens and samples between them, where argmax picks the first —
-    int8 serving does produce real ties); filters apply only when
-    sampling and are ignored (including for compile caching) when greedy.
+    both tokens and samples between them, where greedy takes the lowest
+    index — int8 serving does produce real ties); filters apply only
+    when sampling and are ignored (including for compile caching) when
+    greedy.
+
+    ``speculative_k > 0`` switches to self-speculative decoding
+    (:func:`_compiled_spec_generate`): n-gram drafts from the sequence
+    so far, one (B, k+1) verify forward per loop iteration. Greedy
+    output is token-identical to ``speculative_k=0`` (accepted drafts
+    are verified equal to the greedy rollout; the bonus token IS the
+    greedy token at the rejection point) — only the step count changes.
+    Sampled output is distributionally exact (the standard rejection
+    rule) but a DIFFERENT draw stream than non-speculative sampling:
+    per-row keys split three ways per verify step.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
@@ -158,10 +260,21 @@ def generate(
         # retrace an identical program (compile is the multi-second cost
         # at serving scale)
         top_k, top_p = 0, 1.0
+    if speculative_k < 0:
+        raise ValueError(f"speculative_k must be >= 0, got {speculative_k}")
     model = _window_model(model, total)
-    run = _compiled_generate(
-        model, p_len, total, float(temperature), int(top_k), float(top_p)
-    )
+    if speculative_k:
+        if spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
+        run = _compiled_spec_generate(
+            model, p_len, total, float(temperature), int(top_k),
+            float(top_p), int(speculative_k), int(spec_ngram),
+        )
+    else:
+        run = _compiled_generate(
+            model, p_len, total, float(temperature), int(top_k),
+            float(top_p),
+        )
     return run(params, tokens0, rng)
 
 

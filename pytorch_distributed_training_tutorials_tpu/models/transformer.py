@@ -262,6 +262,59 @@ def _store_decode_kv(var, val: jax.Array, pos: jax.Array) -> None:
         var.value = var.value.at[rows, cols].set(val, mode="drop")
 
 
+def _is_cache_index(path) -> bool:
+    """Is this tree_map_with_path leaf a ``cache_index`` counter?"""
+    key = path[-1]
+    return str(getattr(key, "key", getattr(key, "idx", key))) == "cache_index"
+
+
+def rewind_cache_index(cache, steps):
+    """Roll every ``cache_index`` counter in a decode ``cache`` tree back
+    by per-row ``steps`` — the speculative-verify rewind (serve/engine.py,
+    models/generate.py ``speculative_k``): a ``(B, k+1)`` verify chunk
+    advances the counters by ``k+1``, but only ``1 + n_accept`` of those
+    K/V entries (the chunk's first input plus the accepted draft tokens)
+    are real, so the counters step back by ``k - n_accept``.
+
+    Only the COUNTERS move; the rejected positions' K/V entries stay in
+    the cache as stale rows. That is safe by construction: the next
+    decode chunk writes ``k+1`` fresh positions starting at the rewound
+    counter, which covers every stale position before any query can
+    attend to it (stale entries sit at ``[new_pos, old_pos)`` and
+    ``new_pos + k >= old_pos - 1`` always), and the validity mask bounds
+    reads at the query's own position meanwhile. ``steps`` is ``(B,)``
+    (broadcasting over the leading layer axis of ``scan_layers``-stacked
+    ``(L, B)`` counters) or a scalar."""
+
+    def upd(path, leaf):
+        if _is_cache_index(path):
+            return leaf - jnp.asarray(steps, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(upd, cache)
+
+
+def widen_cache_index(cache, n_rows: int):
+    """Widen scalar ``cache_index`` counters to per-row ``(n_rows,)``
+    vectors (trailing axis — ``(L,) -> (L, n_rows)`` under
+    ``scan_layers``), leaving every other leaf alone. The decode path
+    branches on the counters' trace-time rank (see ``Attention``), so
+    this flips a freshly prefilled ``generate()``-layout cache into the
+    slot-indexed layout where each batch row decodes at its OWN depth —
+    what ``generate(..., speculative_k=...)`` needs once per-row accepted
+    lengths diverge (serve/ builds its state in this layout from the
+    start, :func:`..serve.slots.init_slot_state`)."""
+
+    def upd(path, leaf):
+        if _is_cache_index(path):
+            return jnp.broadcast_to(
+                leaf[..., None], leaf.shape + (n_rows,)
+            ).astype(jnp.int32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(upd, cache)
+
+
 def _expand_kv(kv: jax.Array, n_heads: int) -> jax.Array:
     """Repeat grouped K/V heads up to the query head count (GQA -> MHA
     view); identity when the counts already match."""
@@ -647,9 +700,14 @@ class TransformerLM(nn.Module):
                 # the gathered hidden state equals the unpadded prefill's.
                 # The decode=True variant is the chunked SUFFIX prefill of
                 # a prefix-cache hit (serve/engine.py): ``last_pos`` is the
-                # LOCAL index of the last real suffix token. decode with
-                # last_pos=None keeps the full (B, S, V) logits — the
-                # generate()/serve chain contract (S == 1) is unchanged.
+                # LOCAL index of the last real suffix token. Scalar or
+                # per-row (B,) vector both work — the broadcast below is
+                # the whole plumbing. decode with last_pos=None keeps the
+                # full (B, S, V) logits — the generate()/serve chain
+                # contract (S == 1), and ALSO what the speculative verify
+                # forward rides on: a (B, k+1) chunk needs every
+                # position's logits to judge the k draft tokens
+                # (speculative_accept, models/sampling.py).
                 lp = jnp.broadcast_to(
                     jnp.asarray(last_pos, jnp.int32), (x.shape[0],)
                 )

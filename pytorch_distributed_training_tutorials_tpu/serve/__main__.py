@@ -15,8 +15,15 @@ family, per-request tails) through two engines — prefix cache OFF and ON
 (``prefix_cache_bytes``) — and requires byte-identical greedy tokens, a
 hit rate > 0, FEWER full prefills (splices replace them, counted not
 estimated), and the same one-fetch-per-chain budget with splices
-included. Prints exactly one JSON line (a ``graft-receipt/v1`` envelope)
-and exits non-zero on any failure.
+included. A third (``--spec-k``) arm replays a repetitive/templated
+stream through a ``speculative_k > 0`` engine: greedy tokens must stay
+byte-identical to the non-speculative engine, the fetch budget is
+unchanged (the (S, T, k+1) block + counts ride the chain's ONE batched
+fetch), and the MECHANISM must have fired — mean accepted length > 1
+and sequential verify forwards strictly below tokens emitted (the whole
+point of speculation: fewer sequential decode steps than tokens).
+Prints exactly one JSON line (a ``graft-receipt/v1`` envelope) and
+exits non-zero on any failure.
 """
 
 from __future__ import annotations
@@ -27,7 +34,7 @@ import os
 import sys
 
 
-def selftest(json_path: str | None = None) -> dict:
+def selftest(json_path: str | None = None, spec_k: int = 2) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -200,6 +207,81 @@ def selftest(json_path: str | None = None) -> dict:
             f"{eng_on.n_splices} splices)"
         )
 
+    # ------------------------------------------------------------------
+    # speculative arm: a repetitive/templated stream (the workload
+    # prompt-lookup drafting exists for) through a speculate-k engine —
+    # byte-identical greedy tokens vs the non-speculative engine, the
+    # same fetch budget, AND the mechanism visibly firing: accepted
+    # length > 1 and fewer sequential verify forwards than tokens out
+    # ------------------------------------------------------------------
+    template = [7, 8, 9, 10, 11]
+    spec_reqs = []
+    for i, (reps, max_new) in enumerate(
+        [(4, 18), (3, 14), (4, 20), (3, 16)]
+    ):
+        spec_reqs.append((template * reps + [20 + i], max_new))
+
+    def run_spec_stream(k):
+        eng = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=8,
+            speculative_k=k,
+        )
+        count = {"n": 0}
+
+        def counting(x):
+            count["n"] += 1
+            return real_get(x)
+
+        jax.device_get = counting
+        try:
+            out = {}
+            pending = list(spec_reqs)
+            for toks, max_new in pending[:2]:
+                eng.submit(Request(prompt=toks, max_new_tokens=max_new))
+            pending = pending[2:]
+            while not eng.idle or pending:
+                while pending:
+                    toks, max_new = pending[0]
+                    try:
+                        eng.submit(
+                            Request(prompt=toks, max_new_tokens=max_new)
+                        )
+                        pending.pop(0)
+                    except QueueFull:
+                        break
+                for c in eng.step():
+                    out[c.request_id] = c.tokens
+        finally:
+            jax.device_get = real_get
+        return eng, out, count["n"]
+
+    eng_plain, toks_plain, _ = run_spec_stream(0)
+    eng_spec, toks_spec, fetches_spec = run_spec_stream(spec_k)
+    sstats = eng_spec.spec_stats()
+    spec_exact = toks_spec == toks_plain
+    if not spec_exact:
+        problems.append(
+            f"speculation changed greedy tokens: {toks_spec} != "
+            f"{toks_plain}"
+        )
+    spec_budget = eng_spec.n_chains + eng_spec.n_prefills
+    if fetches_spec > spec_budget:
+        problems.append(
+            f"spec arm: {fetches_spec} host fetches > {spec_budget} "
+            f"({eng_spec.n_chains} chains + {eng_spec.n_prefills} "
+            f"prefills)"
+        )
+    if sstats["spec_mean_accepted_len"] <= 1.0:
+        problems.append(
+            f"drafting never helped on a repetitive stream: {sstats}"
+        )
+    if sstats["n_verify_forwards"] >= eng_spec.generated_tokens:
+        problems.append(
+            f"{sstats['n_verify_forwards']} verify forwards >= "
+            f"{eng_spec.generated_tokens} tokens emitted — speculation "
+            f"saved no sequential steps"
+        )
+
     receipt = make_receipt(
         "serve_selftest",
         {
@@ -217,6 +299,11 @@ def selftest(json_path: str | None = None) -> dict:
             "prefix_prefills_off": eng_off.n_prefills,
             "prefix_prefills_on": eng_on.n_prefills,
             **stats,
+            "spec_requests": len(spec_reqs),
+            "spec_token_exact": spec_exact,
+            "spec_generated_tokens": eng_spec.generated_tokens,
+            "spec_host_fetches": fetches_spec,
+            **sstats,
             "problems": problems,
             "ok": not problems,
         },
@@ -240,6 +327,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--json", default=None, help="also write the receipt to this path"
     )
+    parser.add_argument(
+        "--spec-k", type=int, default=2,
+        help="speculate-k for the speculative selftest arm (>= 1)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -258,7 +349,7 @@ def main(argv: list[str] | None = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    receipt = selftest(args.json)
+    receipt = selftest(args.json, spec_k=args.spec_k)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

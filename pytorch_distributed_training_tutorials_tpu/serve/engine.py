@@ -38,7 +38,20 @@ Orca-style (OSDI '22) fix, built TPU-native:
 - sampling is the SAME pipeline ``generate()`` uses
   (:mod:`..models.sampling`), vmapped over per-slot PRNG streams: a
   request's draws depend only on its own ``seed`` and draw index, never
-  on co-scheduling.
+  on co-scheduling;
+- with ``speculative_k > 0``, every chain iteration is self-speculative
+  (Leviathan et al. 2023 verify + Saxena 2023 prompt-lookup draft, no
+  second model): ``k`` draft tokens per slot come from an on-device
+  n-gram match over the slot's recent-token history (carried in the
+  decode state — no host round-trip), ONE ``(n_slots, k+1)`` decode
+  forward verifies them through the same chunked-continuation path the
+  prefix cache relies on, and the longest accepted prefix lands while
+  rejected positions are rewound (``rewind_cache_index``; the stale K/V
+  rows are provably overwritten before any query can attend to them —
+  see models/transformer.py). ``k`` is STATIC; the accepted length is
+  *data*, so nothing recompiles and the chain still costs one launch +
+  ONE batched fetch — it just returns an ``(n_slots, steps, k+1)``
+  token block plus per-step emit counts instead of one token per step.
 
 Greedy decoding is token-exact vs one-shot ``generate()`` (same math,
 same cache semantics; pinned by tests/test_serve.py). Temperature /
@@ -55,8 +68,13 @@ import jax
 import jax.numpy as jnp
 
 from pytorch_distributed_training_tutorials_tpu.models.sampling import (
+    ngram_draft,
     sample_logits,
     sample_logits_per_slot,
+    speculative_accept,
+)
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    rewind_cache_index,
 )
 from pytorch_distributed_training_tutorials_tpu.serve.prefix import PrefixIndex
 from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
@@ -120,19 +138,35 @@ class ServeEngine:
         top_p: float = 1.0,
         prefix_cache_bytes: int = 0,
         min_hit_depth: int = 1,
+        speculative_k: int = 0,
+        spec_ngram: int = 3,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if tokens_per_launch < 1:
             raise ValueError("tokens_per_launch must be >= 1")
+        if speculative_k < 0:
+            raise ValueError("speculative_k must be >= 0")
         self.model = model
         self.params = params
         self.n_slots = n_slots
         self.tokens_per_launch = tokens_per_launch
         self.window = int(model.cfg.max_seq_len)
+        # speculate-k: 0 = off (the engine then compiles byte-identical
+        # programs to the pre-speculation one — no hist state, old chain)
+        self._spec = speculative_k > 0
+        self._spec_k = int(speculative_k)
+        self._spec_ngram = int(spec_ngram)
+        if self._spec and speculative_k + 1 > self.window:
+            raise ValueError("speculative_k + 1 must fit the window")
+        if self._spec and spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
         self.scheduler = FifoScheduler(self.window, max_queue=max_queue)
         self._slots: list[_Active | None] = [None] * n_slots
-        self._state = init_slot_state(model, params, n_slots)
+        self._state = init_slot_state(
+            model, params, n_slots,
+            history=self.window if self._spec else 0,
+        )
         self._scan_layers = bool(getattr(model.cfg, "scan_layers", False))
         self._temperature = float(temperature)
         self._top_k = int(top_k)
@@ -160,12 +194,21 @@ class ServeEngine:
         self.n_splices = 0
         self.prefix_hit_tokens = 0
         self.generated_tokens = 0
+        # speculative counters: sequential verify forwards dispatched,
+        # verify steps whose tokens an active slot consumed, and draft
+        # tokens accepted (emitted beyond the guaranteed 1/step)
+        self.n_verify_forwards = 0
+        self.spec_steps_consumed = 0
+        self.spec_drafts_accepted = 0
         # donating the state tree lets XLA update the multi-hundred-MB
         # cache in place; CPU jit warns on donation (unsupported), so
         # only donate where it is real
         donate = (1,) if jax.default_backend() in ("tpu", "gpu") else ()
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
-        self._chain = jax.jit(self._chain_fn, donate_argnums=donate)
+        self._chain = jax.jit(
+            self._spec_chain_fn if self._spec else self._chain_fn,
+            donate_argnums=donate,
+        )
         # splice: same donation as prefill (state is arg 1); the retained
         # segment (arg 2) must NEVER be donated — the index keeps serving
         # it to later requests. The two compile statics are keyword-only,
@@ -217,17 +260,21 @@ class ServeEngine:
             if self._retain
             else ()
         )
-        state = {
+        new_state = {
             "cache": cache,
             "last_tok": state["last_tok"].at[slot].set(first[0]),
             "keys": state["keys"].at[slot].set(key),
             # the first generated token is already accounted for
             "remaining": state["remaining"].at[slot].set(max_new - 1),
         }
-        return state, first[0], seg
+        if self._spec:
+            new_state.update(_seed_history(
+                state, tokens, p_len, slot, first[0]
+            ))
+        return new_state, first[0], seg
 
-    def _splice_fn(self, params, state, segment, suffix, depth, p_len,
-                   slot, seed, max_new, *, seg_len, grow):
+    def _splice_fn(self, params, state, segment, suffix, full, depth,
+                   p_len, slot, seed, max_new, *, seg_len, grow):
         """Prefix-cache-hit refill: seed a batch-1 cache from a retained
         ``segment`` at ``depth`` reused positions, run ONE chunked decode
         over the bucket-padded ``suffix`` (1, s_bucket) — the suffix
@@ -243,7 +290,12 @@ class ServeEngine:
         from the pow2 bucket set, so compiles stay bounded by (segment
         bucket, suffix bucket, grow) triples, never per request. With
         ``grow`` the full-prompt segment rides out for insertion —
-        multi-turn streams deepen the index one splice at a time."""
+        multi-turn streams deepen the index one splice at a time.
+
+        ``full`` is the whole bucket-padded prompt (1, bucket) — the
+        n-gram draft history must cover the REUSED prefix too, which
+        ``suffix`` alone cannot seed. Speculation off passes the suffix
+        array again; the operand is then dead and XLA drops it."""
         cache1 = seed_cache(self._proto1, segment, depth)
         logits, upd = self.model.apply(
             {"params": params, "cache": cache1}, suffix, decode=True,
@@ -262,13 +314,17 @@ class ServeEngine:
             if grow
             else ()
         )
-        state = {
+        new_state = {
             "cache": cache,
             "last_tok": state["last_tok"].at[slot].set(first[0]),
             "keys": state["keys"].at[slot].set(key),
             "remaining": state["remaining"].at[slot].set(max_new - 1),
         }
-        return state, first[0], seg
+        if self._spec:
+            new_state.update(_seed_history(
+                state, full, p_len, slot, first[0]
+            ))
+        return new_state, first[0], seg
 
     def _chain_fn(self, params, state):
         """``tokens_per_launch`` decode steps as one ``lax.scan`` — one
@@ -307,6 +363,82 @@ class ServeEngine:
         }
         return state, toks.T  # (n_slots, tokens_per_launch)
 
+    def _spec_chain_fn(self, params, state):
+        """Speculate-k decode chain: ``tokens_per_launch`` iterations of
+        draft -> verify -> accept/rewind, one ``lax.scan``, one launch.
+
+        Per iteration every slot (a) drafts ``k`` tokens via longest
+        n-gram suffix match over its history buffer
+        (:func:`..models.sampling.ngram_draft` — fixed-shape gather/
+        compare, no host round-trip), (b) verifies ``[last_tok, drafts]``
+        in ONE (S, k+1) decode forward — the chunked-continuation path,
+        so logits at position i condition on drafts < i exactly as
+        sequential decode would, (c) accepts the longest matching prefix
+        plus the standard bonus/rejection token
+        (:func:`..models.sampling.speculative_accept`) and REWINDS each
+        slot's position counter by the rejected count
+        (:func:`..models.transformer.rewind_cache_index` — the forward
+        advanced all counters by k+1; stale K/V at rejected positions is
+        overwritten by the next iteration's writes before any query can
+        attend there, and out-of-window writes drop via the
+        ``mode="drop"`` scatter).
+
+        Accepted length is DATA: shapes never depend on it, so one
+        compile serves every acceptance pattern. The chain emits a fixed
+        (S, T, k+1) token block + (S, T) per-step emit counts; inactive
+        slots emit count 0 and their history is untouched (their scatter
+        columns clamp out via ``mode="drop"``)."""
+        k = self._spec_k
+        rows = jnp.arange(self.n_slots)
+        offs = jnp.arange(k + 1)
+        win = self.window
+
+        def step(carry, _):
+            cache, tok, keys, remaining, hist, hist_len = carry
+            active = remaining > 0
+            draft = ngram_draft(hist, hist_len, k, self._spec_ngram)
+            toks_in = jnp.concatenate([tok[:, None], draft], axis=1)
+            logits, upd = self.model.apply(
+                {"params": params, "cache": cache}, toks_in,
+                decode=True, mutable=["cache"],
+            )
+            emitted, n_acc, keys = speculative_accept(
+                logits.astype(jnp.float32), draft, keys,
+                self._temperature, self._top_k, self._top_p,
+            )
+            # the verify forward advanced every counter by k+1; the slot
+            # really produced 1 + n_acc tokens, so rewind the rest
+            cache = rewind_cache_index(upd["cache"], k - n_acc)
+            n_emit = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+            new_tok = jnp.where(active, emitted[rows, n_acc], tok)
+            cols = jnp.where(
+                offs[None, :] < n_emit[:, None],
+                hist_len[:, None] + offs[None, :], win,
+            )
+            hist = hist.at[rows[:, None], cols].set(
+                emitted, mode="drop"
+            )
+            hist_len = jnp.minimum(hist_len + n_emit, win)
+            remaining = jnp.maximum(
+                remaining - n_emit, 0
+            ).astype(remaining.dtype)
+            carry = (cache, new_tok, keys, remaining, hist, hist_len)
+            return carry, (emitted, n_emit)
+
+        carry = (
+            state["cache"], state["last_tok"], state["keys"],
+            state["remaining"], state["hist"], state["hist_len"],
+        )
+        (cache, tok, keys, remaining, hist, hist_len), (toks, counts) = (
+            jax.lax.scan(step, carry, None, length=self.tokens_per_launch)
+        )
+        state = {
+            "cache": cache, "last_tok": tok, "keys": keys,
+            "remaining": remaining, "hist": hist, "hist_len": hist_len,
+        }
+        # (S, T, k+1) token block + (S, T) counts
+        return state, (jnp.transpose(toks, (1, 0, 2)), counts.T)
+
     # ------------------------------------------------------------------
     # host-side driver
     # ------------------------------------------------------------------
@@ -342,10 +474,17 @@ class ServeEngine:
                 break
             done.extend(self._refill(s, req))
         if self.active_slots:
-            self._state, toks = self._chain(self.params, self._state)
-            self.n_chains += 1
-            toks = jax.device_get(toks)  # the chain's ONE host fetch
-            done.extend(self._distribute(toks))
+            if self._spec:
+                self._state, out = self._chain(self.params, self._state)
+                self.n_chains += 1
+                self.n_verify_forwards += self.tokens_per_launch
+                toks, counts = jax.device_get(out)  # ONE batched fetch
+                done.extend(self._distribute_spec(toks, counts))
+            else:
+                self._state, toks = self._chain(self.params, self._state)
+                self.n_chains += 1
+                toks = jax.device_get(toks)  # the chain's ONE host fetch
+                done.extend(self._distribute(toks))
         return done
 
     def run_until_idle(self, max_steps: int = 10_000) -> list[Completion]:
@@ -387,9 +526,14 @@ class ServeEngine:
                 [suffix + [0] * (s_bucket - len(suffix))], jnp.int32
             )
             self.prefix.acquire(segment)
+            full = (
+                jnp.asarray([prompt + [0] * (bucket - p_len)], jnp.int32)
+                if self._spec
+                else tokens  # dead operand when speculation is off
+            )
             self._state, first, new_seg = self._splice(
-                self.params, self._state, segment.handle, tokens, depth,
-                p_len, slot, req.seed, req.max_new_tokens,
+                self.params, self._state, segment.handle, tokens, full,
+                depth, p_len, slot, req.seed, req.max_new_tokens,
                 seg_len=bucket, grow=grow,
             )
             self.n_splices += 1
@@ -452,6 +596,46 @@ class ServeEngine:
                 done.append(self._complete(act, reason))
         return done
 
+    def _distribute_spec(self, toks, counts) -> list[Completion]:
+        """Speculative twin of :meth:`_distribute`: unpack one fetched
+        (S, T, k+1) block. Step t of slot s contributed ``counts[s, t]``
+        real tokens — the accepted draft prefix plus the bonus/rejection
+        token — and the rest of the row is padding. The host truncates at
+        the request's budget exactly like ``generate()`` does (the device
+        may have verified past it within the chain; those writes land in
+        the slot's own window and refill rewrites the whole slot)."""
+        done: list[Completion] = []
+        for s, act in enumerate(self._slots):
+            if act is None:
+                continue
+            reason = None
+            for t in range(counts.shape[1]):
+                n = int(counts[s, t])
+                if n == 0:  # slot went inactive device-side
+                    break
+                self.spec_steps_consumed += 1
+                self.spec_drafts_accepted += n - 1
+                for tok_ in toks[s, t, : min(n, act.remaining)]:
+                    tok = int(tok_)
+                    act.tokens.append(tok)
+                    act.remaining -= 1
+                    self.generated_tokens += 1
+                    if tok == act.request.eos_token:
+                        reason = "eos"
+                        break
+                if reason is not None or act.remaining == 0:
+                    break
+            if reason is None and act.remaining == 0:
+                reason = "length"
+            if reason is not None:
+                self._slots[s] = None
+                if act.remaining > 0:  # finished mid-chain via EOS
+                    self._state["remaining"] = self._park(
+                        self._state["remaining"], s
+                    )
+                done.append(self._complete(act, reason))
+        return done
+
     def _complete(self, act: _Active, reason: str) -> Completion:
         if act.segment is not None:
             # the slot no longer decodes from this segment's splice;
@@ -482,6 +666,44 @@ class ServeEngine:
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "n_splices": self.n_splices,
         }
+
+    def spec_stats(self) -> dict[str, int | float]:
+        """Speculation counters for the serving receipt. Mean accepted
+        length is per CONSUMED verify step (1.0 would mean drafting never
+        helped; the mechanism receipt wants > 1); acceptance rate is the
+        fraction of offered draft tokens accepted. All host bookkeeping —
+        no device fetch."""
+        if not self._spec:
+            return {"speculative": 0}
+        steps = max(1, self.spec_steps_consumed)
+        return {
+            "speculative": 1,
+            "spec_k": self._spec_k,
+            "spec_ngram": self._spec_ngram,
+            "n_verify_forwards": self.n_verify_forwards,
+            "spec_steps_consumed": self.spec_steps_consumed,
+            "spec_drafts_accepted": self.spec_drafts_accepted,
+            "spec_mean_accepted_len":
+                1.0 + self.spec_drafts_accepted / steps,
+            "spec_acceptance_rate":
+                self.spec_drafts_accepted / (steps * self._spec_k),
+        }
+
+
+def _seed_history(state, tokens, p_len, slot, first):
+    """Reset slot ``slot``'s n-gram history to [prompt, first token]:
+    the bucket-padded prompt row lands whole (junk beyond ``p_len`` is
+    masked by ``hist_len`` in :func:`..models.sampling.ngram_draft`),
+    the first sampled token overwrites the pad at position ``p_len``.
+    ``slot`` / ``p_len`` are traced — no compile per slot or length."""
+    hist = jax.lax.dynamic_update_slice(
+        state["hist"], tokens, (slot, 0)
+    )
+    hist = hist.at[slot, p_len].set(first)
+    return {
+        "hist": hist,
+        "hist_len": state["hist_len"].at[slot].set(p_len + 1),
+    }
 
 
 def _park_slot(remaining, slot):

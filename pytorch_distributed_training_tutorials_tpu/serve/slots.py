@@ -57,7 +57,7 @@ def bucket_len(p_len: int, window: int, floor: int = 8) -> int:
     return min(b, window)
 
 
-def init_slot_state(model, params, n_slots: int):
+def init_slot_state(model, params, n_slots: int, history: int = 0):
     """Zero-initialized slot-state pytree for ``n_slots`` concurrent
     requests of ``model`` (a :class:`..models.transformer.TransformerLM`
     or anything sharing its cache contract).
@@ -76,6 +76,16 @@ def init_slot_state(model, params, n_slots: int):
     (:func:`..models.sampling.sample_logits_per_slot`); ``remaining``
     ``(S,)`` int32 — tokens still to generate, 0 = slot free/parked (the
     active mask is ``remaining > 0``).
+
+    ``history > 0`` (the engine passes its window when speculate-k is on)
+    adds the per-slot recent-token buffer the on-device n-gram draft
+    feeds on (:func:`..models.sampling.ngram_draft`): ``hist`` ``(S,
+    history)`` int32 — each slot's known tokens, prompt + emitted, junk
+    beyond ``hist_len`` — and ``hist_len`` ``(S,)`` int32. Both are
+    reseeded at refill and carried through the decode chain, so drafting
+    never costs a host round-trip. Speculation off keeps the state tree
+    (and therefore every compiled program) byte-identical to the
+    pre-speculation engine.
     """
     if n_slots < 1:
         raise ValueError("n_slots must be >= 1")
@@ -95,12 +105,16 @@ def init_slot_state(model, params, n_slots: int):
             return jnp.zeros(leaf.shape + (n_slots,), jnp.int32)
         return jnp.zeros(leaf.shape, leaf.dtype)
 
-    return {
+    state = {
         "cache": jax.tree_util.tree_map_with_path(build, shapes),
         "last_tok": jnp.zeros((n_slots,), jnp.int32),
         "keys": jnp.zeros((n_slots, 2), jnp.uint32),
         "remaining": jnp.zeros((n_slots,), jnp.int32),
     }
+    if history > 0:
+        state["hist"] = jnp.zeros((n_slots, history), jnp.int32)
+        state["hist_len"] = jnp.zeros((n_slots,), jnp.int32)
+    return state
 
 
 def write_slot(cache, prefill_cache, slot, p_len, scan_layers: bool):

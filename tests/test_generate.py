@@ -376,3 +376,180 @@ def test_filter_logits_nucleus_cap_degrades_to_top_cap():
     np.testing.assert_array_equal(np.sort(np.nonzero(kept)[0]),
                                   np.sort(order[:G._NUCLEUS_CANDIDATES]))
     np.testing.assert_array_equal(out[0][kept], np.asarray(logits)[0][kept])
+
+
+# ------------------------------------------------- speculative decoding
+
+def test_greedy_tie_break_is_lowest_index():
+    """Exact logit ties resolve to the smallest vocabulary index in every
+    greedy consumer — the explicit contract the int8 near-tie paths and
+    the speculative verify both lean on (a tie resolved differently in
+    the verify forward vs the sequential path would silently break the
+    speculation-is-invisible guarantee)."""
+    from pytorch_distributed_training_tutorials_tpu.models.sampling import (
+        greedy_token,
+        sample_logits,
+        sample_logits_per_slot,
+    )
+
+    logits = jnp.asarray(
+        [[0.0, 3.0, 3.0, 1.0], [2.0, 2.0, 2.0, 2.0]], jnp.float32
+    )
+    np.testing.assert_array_equal(np.asarray(greedy_token(logits)), [1, 0])
+    tok, _ = sample_logits(logits, jax.random.PRNGKey(0), 0.0)
+    np.testing.assert_array_equal(np.asarray(tok), [1, 0])
+    tok, _ = sample_logits_per_slot(
+        logits, jnp.zeros((2, 2), jnp.uint32), 0.0
+    )
+    np.testing.assert_array_equal(np.asarray(tok), [1, 0])
+
+
+def test_ngram_draft_copies_the_continuation_of_the_longest_match():
+    """A history whose trailing n-gram occurred before drafts the tokens
+    that followed that occurrence; rows without any prior match fall back
+    to repeating their last token (a harmless guess for the verifier)."""
+    from pytorch_distributed_training_tutorials_tpu.models.sampling import ngram_draft
+
+    hist = jnp.asarray(
+        [
+            # ...5 6 7 [8 9] then later [8 9] again -> draft 5 6 7
+            [8, 9, 5, 6, 7, 8, 9, 0, 0, 0],
+            # no repeat anywhere -> fall back to last token (4)
+            [1, 2, 3, 4, 0, 0, 0, 0, 0, 0],
+        ],
+        jnp.int32,
+    )
+    hist_len = jnp.asarray([7, 4], jnp.int32)
+    draft = np.asarray(ngram_draft(hist, hist_len, k=3, ngram=2))
+    np.testing.assert_array_equal(draft[0], [5, 6, 7])
+    np.testing.assert_array_equal(draft[1], [4, 4, 4])
+
+
+def test_ngram_draft_prefers_longest_then_most_recent_match():
+    """Scoring is (match length, recency): a longer suffix match beats a
+    more recent shorter one, and among equal lengths the most recent
+    occurrence wins."""
+    from pytorch_distributed_training_tutorials_tpu.models.sampling import ngram_draft
+
+    # trailing bigram [2 3]: position 1 matches [2 3] (len 2, cont 7),
+    # position 5 matches only [.. 3]? no — build it explicitly:
+    # hist = 2 3 7 1 2 3 9 | current suffix [2 3] occurs at idx 1 (->7)
+    # and idx 5 (->9); most recent (idx 5) must win
+    hist = jnp.asarray([[2, 3, 7, 1, 2, 3, 9, 2, 3, 0]], jnp.int32)
+    hist_len = jnp.asarray([9], jnp.int32)
+    draft = np.asarray(ngram_draft(hist, hist_len, k=1, ngram=2))
+    np.testing.assert_array_equal(draft[0], [9])
+
+
+def test_speculative_accept_greedy_prefix_and_bonus():
+    """Greedy accept: the emitted block's first n_accept tokens equal the
+    draft where it matches the verifier's greedy rollout, and position
+    n_accept is the verifier's own token — so emitted[:n_accept + 1] IS
+    the greedy continuation regardless of draft quality."""
+    from pytorch_distributed_training_tutorials_tpu.models.sampling import (
+        speculative_accept,
+    )
+
+    v = 8
+    # verifier greedy tokens per position: [3, 5, 1]
+    logits = jnp.full((1, 3, v), -10.0).at[0, 0, 3].set(0.0)
+    logits = logits.at[0, 1, 5].set(0.0).at[0, 2, 1].set(0.0)
+    keys = jnp.zeros((1, 2), jnp.uint32)
+    # draft [3, 5] fully accepted -> emits [3, 5, 1] (bonus from p_k)
+    emitted, n_acc, _ = speculative_accept(
+        logits, jnp.asarray([[3, 5]], jnp.int32), keys, 0.0
+    )
+    assert int(n_acc[0]) == 2
+    np.testing.assert_array_equal(np.asarray(emitted[0]), [3, 5, 1])
+    # draft [3, 4] rejected at position 1 -> emits [3, 5, ...] (2 tokens)
+    emitted, n_acc, _ = speculative_accept(
+        logits, jnp.asarray([[3, 4]], jnp.int32), keys, 0.0
+    )
+    assert int(n_acc[0]) == 1
+    np.testing.assert_array_equal(np.asarray(emitted[0, :2]), [3, 5])
+    # draft [0, 5]: first token wrong -> only the bonus token emits
+    emitted, n_acc, _ = speculative_accept(
+        logits, jnp.asarray([[0, 5]], jnp.int32), keys, 0.0
+    )
+    assert int(n_acc[0]) == 0
+    assert int(emitted[0, 0]) == 3
+
+
+def test_speculative_accept_sampled_point_mass_limits():
+    """The rejection rule at its deterministic limits: a draft token
+    carrying ~all probability mass is always accepted; one carrying ~zero
+    mass is always rejected and the bonus comes from the residual — which
+    can never be the rejected token itself."""
+    from pytorch_distributed_training_tutorials_tpu.models.sampling import (
+        speculative_accept,
+    )
+
+    v, k = 8, 2
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(64))
+    sure = jnp.full((1, k + 1, v), -30.0)
+    sure = sure.at[0, 0, 3].set(0.0).at[0, 1, 5].set(0.0)
+    sure = sure.at[0, 2, 1].set(0.0)
+    for i in range(0, 64, 2):
+        e, n, _ = speculative_accept(
+            sure, jnp.asarray([[3, 5]], jnp.int32), keys[i:i + 1], 1.0
+        )
+        assert int(n[0]) == 2
+        np.testing.assert_array_equal(np.asarray(e[0]), [3, 5, 1])
+    for i in range(0, 64, 2):
+        e, n, _ = speculative_accept(
+            sure, jnp.asarray([[0, 5]], jnp.int32), keys[i:i + 1], 1.0
+        )
+        assert int(n[0]) == 0  # p(0) ~ 0 -> reject
+        assert int(e[0, 0]) != 0  # residual masks the rejected token
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+@pytest.mark.parametrize("k", [1, 3])
+def test_speculative_generate_greedy_token_identical(scan_layers, k):
+    """generate(speculative_k=k) greedy output is token-identical to
+    plain generate() — accepted drafts are verified equal to the greedy
+    rollout and the bonus IS the greedy token at the rejection point, so
+    speculation only changes the step count, never the tokens. Pinned
+    across the unrolled and nn.scan layouts and batch > 1 (per-row
+    accepted lengths diverge -> the widened per-row cache counters)."""
+    model, params = _model(scan_layers=scan_layers)
+    rng = np.random.Generator(np.random.PCG64(5))
+    # a repetitive prompt so drafting actually fires, plus a random row
+    rep = np.tile([3, 4, 5], 3)[:8]
+    rand = rng.integers(0, 32, (8,))
+    prompt = jnp.asarray(np.stack([rep, rand]), jnp.int32)
+    base = generate(model, params, prompt, max_new_tokens=14)
+    spec = generate(
+        model, params, prompt, max_new_tokens=14, speculative_k=k
+    )
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(base))
+
+
+def test_speculative_generate_max_new_one_and_validation():
+    model, params = _model()
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    base = generate(model, params, prompt, max_new_tokens=1)
+    spec = generate(
+        model, params, prompt, max_new_tokens=1, speculative_k=2
+    )
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(base))
+    with pytest.raises(ValueError):
+        generate(model, params, prompt, 4, speculative_k=-1)
+    with pytest.raises(ValueError):
+        generate(model, params, prompt, 4, speculative_k=2, spec_ngram=0)
+
+
+def test_speculative_generate_sampled_runs_and_is_seeded():
+    """Sampled speculative generation: in-vocab, reproducible per rng,
+    and a different rng changes the stream (distributional exactness is
+    pinned at the unit level — the draw stream legitimately differs from
+    non-speculative sampling)."""
+    model, params = _model()
+    prompt = jnp.asarray([[3, 4, 5, 3, 4, 5, 3, 4]], jnp.int32)
+    kw = dict(max_new_tokens=12, temperature=0.9, speculative_k=2)
+    a = generate(model, params, prompt, rng=jax.random.PRNGKey(7), **kw)
+    b = generate(model, params, prompt, rng=jax.random.PRNGKey(7), **kw)
+    c = generate(model, params, prompt, rng=jax.random.PRNGKey(8), **kw)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    assert ((np.asarray(a) >= 0) & (np.asarray(a) < 32)).all()

@@ -25,6 +25,17 @@ The load-bearing pins:
   estimated — splices replace them), the fetch budget extends by exactly
   one scalar per splice, and forced LRU eviction under a tiny byte
   budget changes counters, never tokens;
+- self-speculative decoding (``speculative_k``, ISSUE 7) is INVISIBLE
+  in greedy tokens: speculate-k streams are byte-identical to the
+  non-speculative engine, to one-shot ``generate()``, and to
+  ``generate(..., speculative_k=...)`` across the unrolled,
+  ``scan_layers``, GQA, and int8-KV layouts, including finish-mid-chain
+  and composed with prefix-cache splices (both share the vector
+  ``cache_index`` rewind machinery); the fetch budget is UNCHANGED with
+  ``spec_k > 1`` (the (S, T, k+1) block + counts ride the chain's one
+  batched fetch); and the mechanism visibly fires on a repetitive
+  stream — mean accepted length > 1, sequential verify forwards <
+  tokens emitted;
 - ``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` succeeds in a
   subprocess (the tier-1 wiring for the end-to-end smoke).
 """
@@ -548,6 +559,205 @@ def test_prefix_cache_multi_turn_deepens_the_index(model_params):
     assert tuple(turn2) in engine.prefix
 
 
+# ------------------------------------------- self-speculative decoding
+
+def _template_stream(n_requests=5, seed=21):
+    """A repetitive/templated prompt stream (the prompt-lookup workload):
+    each prompt is a short template tiled a few times plus a distinct
+    suffix token, with mixed budgets."""
+    template = [7, 8, 9, 10, 11]
+    return [
+        (template * (3 + i % 2) + [20 + i + seed], 10 + 3 * (i % 3))
+        for i in range(n_requests)
+    ]
+
+
+def test_spec_token_exact_staggered(model_params):
+    """The ISSUE 7 acceptance pin: a staggered speculate-k stream is
+    byte-identical greedy to the non-speculative engine, to one-shot
+    generate(), and to generate(speculative_k=...) — speculation changes
+    the step count, never the tokens."""
+    model, params = model_params
+    reqs = [(_prompt(1300 + i, p), m)
+            for i, (p, m) in enumerate([(3, 9), (7, 12), (5, 5), (12, 6)])]
+    reqs += _template_stream(2)
+    eng_off, out_off = _run_stream(model, params, reqs)
+    eng_on, out_on = _run_stream(model, params, reqs, speculative_k=3)
+    assert [c.tokens for c in out_on] == [c.tokens for c in out_off]
+    for (prompt, max_new), c in zip(reqs, out_on):
+        assert c.tokens == _reference(model, params, prompt, max_new)
+        spec_ref = jax.device_get(generate(
+            model, params, jnp.asarray([prompt], jnp.int32), max_new,
+            speculative_k=3,
+        ))[0, len(prompt):].tolist()
+        assert c.tokens == spec_ref
+
+
+@pytest.mark.parametrize(
+    "cfg_kwargs",
+    [
+        dict(scan_layers=True),
+        dict(n_kv_heads=2),
+    ],
+    ids=["scan_layers", "gqa"],
+)
+def test_spec_variant_layouts(cfg_kwargs):
+    """The draft/verify/rewind machinery rides the nn.scan-stacked cache
+    ((L, S) position counters) and the GQA-shrunk cache identically:
+    still token-exact vs generate()."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, **cfg_kwargs)
+    model, params = _make(cfg)
+    reqs = _template_stream(4)
+    engine, out = _run_stream(model, params, reqs, speculative_k=2)
+    for (prompt, max_new), c in zip(reqs, out):
+        assert c.tokens == _reference(model, params, prompt, max_new)
+    # the templated stream must actually exercise acceptance
+    assert engine.spec_stats()["spec_drafts_accepted"] > 0
+
+
+def test_spec_int8_kv_matches_nonspec_engine():
+    """int8 KV: speculative and non-speculative engines quantize at the
+    same positions with the same values (the rewind only moves counters,
+    accepted K/V rows are written once), so the streams stay
+    byte-identical even where generate()-exactness is off the table
+    (CLAUDE.md's kv_cache_dtype near-tie caveat)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, kv_cache_dtype=jnp.int8)
+    model, params = _make(cfg)
+    reqs = [(_prompt(1400 + i, 4 + i), 8 + i) for i in range(3)]
+    reqs += _template_stream(2, seed=60)
+    _, out_off = _run_stream(model, params, reqs)
+    _, out_on = _run_stream(model, params, reqs, speculative_k=3)
+    assert [c.tokens for c in out_on] == [c.tokens for c in out_off]
+
+
+def test_spec_finish_mid_chain_and_eos(model_params):
+    """Budgets that end inside a verify block: surplus accepted tokens
+    are discarded at the budget exactly like generate() truncating, and
+    EOS inside an accepted block stops at the EOS token and parks the
+    slot while a co-scheduled request stays exact."""
+    model, params = model_params
+    p_short, p_long = [7, 8, 9] * 3, _prompt(1500, 6)
+    ref_short = _reference(model, params, p_short, 12)
+    eos = ref_short[4]
+    stop_at = ref_short.index(eos) + 1
+    engine = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=8, speculative_k=3
+    )
+    i_short = engine.submit(
+        Request(prompt=p_short, max_new_tokens=12, eos_token=eos)
+    )
+    i_long = engine.submit(Request(prompt=p_long, max_new_tokens=19))
+    completions = {c.request_id: c for c in engine.run_until_idle()}
+    assert completions[i_short].finish_reason == "eos"
+    assert completions[i_short].tokens == ref_short[:stop_at]
+    assert completions[i_long].tokens == _reference(
+        model, params, p_long, 19
+    )
+
+
+def test_spec_fetch_budget(model_params, monkeypatch):
+    """The no-per-token-sync contract with spec_k > 1: the (S, T, k+1)
+    block and the per-step counts come back in the chain's ONE batched
+    fetch — the whole speculative stream still costs exactly one fetch
+    per chain plus one scalar per prefill."""
+    model, params = model_params
+    calls = {"n": 0}
+    real_get = jax.device_get
+    monkeypatch.setattr(
+        jax, "device_get",
+        lambda x: (calls.__setitem__("n", calls["n"] + 1), real_get(x))[1],
+    )
+    engine, out = _run_stream(
+        model, params, _template_stream(5), speculative_k=3
+    )
+    assert len(out) == 5
+    assert calls["n"] == engine.n_chains + engine.n_prefills
+
+
+def test_spec_prefix_splice_composed(model_params):
+    """Prefix-cache splices and speculation share the vector cache_index
+    machinery; composed they must still be invisible: spliced speculative
+    streams byte-identical to the plain engine, with both mechanisms
+    measurably firing."""
+    model, params = model_params
+    reqs = _overlap_stream(0.7)
+    _, out_plain = _run_stream(model, params, reqs)
+    engine, out = _run_stream(
+        model, params, reqs, speculative_k=2,
+        prefix_cache_bytes=16 * 1024 * 1024,
+    )
+    assert [c.tokens for c in out] == [c.tokens for c in out_plain]
+    assert engine.n_splices >= 1
+    assert engine.spec_stats()["spec_steps_consumed"] > 0
+
+
+def test_spec_sampled_reproducible_per_seed(model_params):
+    """temperature > 0 under speculation: per-request streams are still a
+    function of the request's own seed, co-scheduling invisible."""
+    model, params = model_params
+    prompt = [3, 4, 5] * 3
+    req = dict(prompt=prompt, max_new_tokens=10, seed=7)
+    kw = dict(tokens_per_launch=8, temperature=1.0, speculative_k=2)
+
+    solo_eng = ServeEngine(model, params, n_slots=2, **kw)
+    rid = solo_eng.submit(Request(**req))
+    solo = {c.request_id: c for c in solo_eng.run_until_idle()}[rid]
+
+    busy_eng = ServeEngine(model, params, n_slots=2, **kw)
+    busy_eng.submit(Request(prompt=_prompt(1600, 9), max_new_tokens=14,
+                            seed=3))
+    rid_busy = busy_eng.submit(Request(**req))
+    busy = {c.request_id: c for c in busy_eng.run_until_idle()}[rid_busy]
+    assert solo.tokens == busy.tokens
+    assert all(0 <= t < CFG.vocab_size for t in solo.tokens)
+
+
+def test_spec_mechanism_fires_on_repetitive_stream(model_params):
+    """The perf mechanism, counted not estimated: on a templated stream
+    the mean accepted length exceeds 1 and the number of SEQUENTIAL
+    verify forwards is strictly below the tokens emitted — speculation
+    bought tokens without sequential steps (the only lever left at the
+    decode roofline, ISSUE 7 / ROADMAP item 2)."""
+    model, params = model_params
+    engine, out = _run_stream(
+        model, params, _template_stream(4), speculative_k=4
+    )
+    stats = engine.spec_stats()
+    assert stats["spec_mean_accepted_len"] > 1.0
+    assert stats["n_verify_forwards"] < engine.generated_tokens
+    assert stats["spec_acceptance_rate"] > 0
+    # the off engine reports itself off
+    assert ServeEngine(model, params).spec_stats() == {"speculative": 0}
+
+
+def test_spec_validation(model_params):
+    model, params = model_params
+    with pytest.raises(ValueError):
+        ServeEngine(model, params, speculative_k=-1)
+    with pytest.raises(ValueError):
+        ServeEngine(model, params, speculative_k=2, spec_ngram=0)
+    with pytest.raises(ValueError):  # k + 1 must fit the window
+        ServeEngine(model, params, speculative_k=CFG.max_seq_len)
+
+
+def test_spec_off_state_is_unchanged(model_params):
+    """speculative_k=0 keeps the slot-state tree (and so the compiled
+    programs) byte-identical to the pre-speculation engine: no history
+    buffers, the plain chain."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=2)
+    assert set(engine._state) == {"cache", "last_tok", "keys", "remaining"}
+    spec = ServeEngine(model, params, n_slots=2, speculative_k=2)
+    assert set(spec._state) == {
+        "cache", "last_tok", "keys", "remaining", "hist", "hist_len",
+    }
+    assert spec._state["hist"].shape == (2, CFG.max_seq_len)
+
+
 # ------------------------------------------------------------- the selftest
 
 def test_serve_selftest_subprocess(tmp_path):
@@ -569,4 +779,10 @@ def test_serve_selftest_subprocess(tmp_path):
     assert validate_receipt(receipt, kind="serve_selftest") == []
     assert receipt["token_exact_mismatches"] == 0
     assert receipt["backpressure_seen"] is True
+    # the speculative arm's mechanism receipt (the ISSUE 7 CPU-mesh
+    # criterion, recorded through make_receipt): token-exact, accepted
+    # length > 1, fewer sequential verify forwards than tokens emitted
+    assert receipt["spec_token_exact"] is True
+    assert receipt["spec_mean_accepted_len"] > 1.0
+    assert receipt["n_verify_forwards"] < receipt["spec_generated_tokens"]
     assert load_receipt(json_path)["ok"] is True

@@ -223,6 +223,38 @@ def test_traced_control_flow_sees_nn_remat_class_with_statics():
     assert [f.line for f in found] == [10]  # only the `if x.sum() > 0`
 
 
+def test_traced_control_flow_catches_python_branch_on_accepted_length():
+    """The speculative-decoding foot-gun (ISSUE 7): the accepted length
+    coming out of the verify step is DATA; branching on it in Python
+    inside the jitted chain is exactly the bug class traced-control-flow
+    exists for — and its jnp.where/cumprod twin (the shape the engine's
+    _spec_chain_fn actually uses) must stay silent."""
+    src = """
+        import jax
+
+        @jax.jit
+        def chain(state, n_accept):
+            if n_accept > 0:            # accepted length is data!
+                state = state + n_accept
+            return state
+    """
+    found = hits(check(src), "traced-control-flow")
+    assert len(found) == 1 and found[0].line == 6
+
+    clean = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def chain(state, draft, out):
+            ok = draft == out           # verify comparison stays on device
+            acc = jnp.cumprod(ok.astype(jnp.int32), axis=-1)
+            n_accept = acc.sum(-1)      # accepted length as DATA
+            return jnp.where(n_accept > 0, state + n_accept, state)
+    """
+    assert not hits(check(clean), "traced-control-flow")
+
+
 # -------------------------------------------------------------- host-sync-hazard
 
 def test_host_sync_fires_inside_jit():
