@@ -266,6 +266,19 @@ def main():
         help="suffix length the n-gram draft matches on (--spec-k)",
     )
     ap.add_argument(
+        "--adapters", type=int, default=0,
+        help="for --server: serve a MULTI-TENANT stream through an N-row "
+        "LoRA adapter bank (adapters.AdapterBank; 0 disables). Rows "
+        "1..N-1 are registered as synthetic tenants and requests cycle "
+        "through all ids (0 = base model) — heterogeneous tenants "
+        "co-batch in the one compiled decode program; the receipt gains "
+        "bank geometry and per-tenant traffic counters",
+    )
+    ap.add_argument(
+        "--lora-rank", type=int, default=8, dest="lora_rank",
+        help="LoRA rank of the adapter bank rows (--adapters)",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -549,6 +562,28 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
 
     from pytorch_distributed_training_tutorials_tpu.serve import Request, ServeEngine
 
+    bank = None
+    if args.adapters:
+        # multi-tenant arm: N-1 synthetic tenants (small random factors)
+        # in one bank; requests cycle through ids 0..N-1 so the stream
+        # mixes the base model with every tenant in the same slots
+        from pytorch_distributed_training_tutorials_tpu.adapters import AdapterBank
+
+        bank = AdapterBank(
+            lm, n_adapters=args.adapters, rank=args.lora_rank
+        )
+        frng = np.random.Generator(np.random.PCG64(13))
+        for aid in range(1, args.adapters):
+            bank.register(
+                f"tenant-{aid}",
+                jax.tree_util.tree_map(
+                    lambda leaf: (
+                        frng.standard_normal(leaf.shape) * 0.02
+                    ).astype(np.float32),
+                    bank.row_zeros(),
+                ),
+            )
+
     window = int(cfg.max_seq_len)
     new = args.new_tokens
     lengths = sorted(
@@ -572,6 +607,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         prefix_cache_bytes=cache_mb * 1024 * 1024,
         speculative_k=args.spec_k,
         spec_ngram=args.spec_ngram,
+        adapter_bank=bank,
     )
     rng = np.random.Generator(np.random.PCG64(11))
     # one shared token family: request i's prompt = shared[:k] + tail,
@@ -585,7 +621,9 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         k = min(p_len, int(round(args.prefix_overlap * p_len)))
         tail = rng.integers(0, cfg.vocab_size, (p_len - k,)).tolist()
         return Request(
-            prompt=shared[:k] + tail, max_new_tokens=new, seed=i
+            prompt=shared[:k] + tail, max_new_tokens=new, seed=i,
+            # cycle every bank row (0 = base) through the shared slots
+            adapter=(i % args.adapters) if bank is not None else 0,
         )
 
     # compile warmup: one request per prompt bucket + the decode chain,
@@ -602,6 +640,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     engine.n_splices = engine.prefix_hit_tokens = 0
     engine.n_verify_forwards = engine.spec_steps_consumed = 0
     engine.spec_drafts_accepted = 0
+    engine.adapter_requests = 0
     if engine.prefix is not None:
         engine.prefix.hits = engine.prefix.misses = 0
 
@@ -641,6 +680,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         prefix_cache_mb=cache_mb,
         **engine.prefix_stats(),
         **engine.spec_stats(),
+        **engine.adapter_stats(),
         backend=jax.default_backend(),
     )
     prefix_note = ""
@@ -657,6 +697,13 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
             f", spec-k {args.spec_k}: mean accepted "
             f"{ss['spec_mean_accepted_len']:.2f}, "
             f"{ss['n_verify_forwards']} verify forwards for {toks} tokens"
+        )
+    if bank is not None:
+        ast = engine.adapter_stats()
+        prefix_note += (
+            f", adapters: {ast['adapters_registered']}/"
+            f"{ast['n_adapters'] - 1} tenants (rank {ast['lora_rank']}), "
+            f"{ast['adapter_requests']} tenant requests"
         )
     print(
         f"server: {args.requests} requests (prompts {lengths}, {new} new "

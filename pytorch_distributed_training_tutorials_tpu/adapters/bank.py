@@ -1,0 +1,191 @@
+"""Stacked LoRA adapter bank: many tenants, one compiled program.
+
+Device half of multi-tenant serving (ROADMAP item 5). The whole bank is
+ONE pytree of stacked factors — ``lora_a`` ``(n_adapters, d_in, rank)``
+and ``lora_b`` ``(n_adapters, rank, d_out)`` per hooked projection
+(leading ``(L,)`` layer axis under ``scan_layers``) — declared as params
+by ``models.transformer.LoRADelta`` and gathered per batch row by
+:func:`apply_lora` INSIDE the compiled program. ``n_adapters`` and
+``rank`` are engine-static (they size the params); the adapter id is
+DATA, so heterogeneous tenants co-batch in the serve engine's one decode
+program with zero recompiles, and registering/evicting a tenant is a
+row write into the same fixed-shape arrays — the weights analogue of the
+slot-indexed KV cache (:mod:`..serve.slots`).
+
+:class:`AdapterBank` pairs the factor tree with the jax-free
+:class:`.registry.AdapterRegistry` (name -> row, byte accounting,
+explicit eviction) and hands the serve engine a merged params tree
+(base params + factor subtrees) plus admission checks for
+``Request.adapter``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tutorials_tpu.adapters.lora import (
+    lora_tree,
+)
+from pytorch_distributed_training_tutorials_tpu.adapters.registry import (
+    AdapterRegistry,
+)
+from pytorch_distributed_training_tutorials_tpu.serve.slots import (
+    tree_nbytes,
+)
+
+
+def apply_lora(x, a, b, adapter_ids, dtype=None):
+    """Per-row low-rank delta ``(x @ A[id]) @ B[id]``.
+
+    ``x`` ``(B, S, d_in)``; ``a`` ``(N, d_in, r)``; ``b`` ``(N, r,
+    d_out)``; ``adapter_ids`` scalar or ``(B,)`` int. Each row's factors
+    are GATHERED by ``jnp.take`` — the id stays traced data end to end
+    (a Python branch on it inside a compiled body is exactly what the
+    graftcheck ``traced-control-flow`` rule rejects), which is what lets
+    requests with different adapters share one compiled program. Row 0
+    and unregistered rows are zero, so their delta is an exact ``0.0``.
+    ``dtype`` mirrors ``nn.Dense(dtype=...)``: operands cast before the
+    matmuls (params themselves stay f32)."""
+    ids = jnp.broadcast_to(
+        jnp.asarray(adapter_ids, jnp.int32), (x.shape[0],)
+    )
+    ai = jnp.take(a, ids, axis=0)  # (B, d_in, r)
+    bi = jnp.take(b, ids, axis=0)  # (B, r, d_out)
+    if dtype is not None:
+        x, ai, bi = x.astype(dtype), ai.astype(dtype), bi.astype(dtype)
+    lo = jnp.einsum("bsd,bdr->bsr", x, ai)
+    return jnp.einsum("bsr,bro->bso", lo, bi)
+
+
+class AdapterBank:
+    """The tenant bank an engine serves from: stacked factors + registry.
+
+    ``model`` is the BASE model (``lora_adapters == 0``) — the bank
+    builds its LoRA twin (``self.model``) by config replacement, so the
+    caller's params keep their base layout. Factor rows start zero
+    (every tenant id resolves to the base model until registered).
+    """
+
+    def __init__(self, model, n_adapters: int, rank: int,
+                 byte_budget: int = 0):
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        cfg = model.cfg
+        if cfg.lora_adapters:
+            if (cfg.lora_adapters, cfg.lora_rank) != (n_adapters, rank):
+                raise ValueError(
+                    "model already has LoRA config "
+                    f"({cfg.lora_adapters}, {cfg.lora_rank}) != "
+                    f"({n_adapters}, {rank})"
+                )
+            lora_cfg = cfg
+        else:
+            lora_cfg = dataclasses.replace(
+                cfg, lora_adapters=n_adapters, lora_rank=rank
+            )
+        self.model = type(model)(lora_cfg)
+        self.n_adapters = int(n_adapters)
+        self.rank = int(rank)
+        self.registry = AdapterRegistry(n_adapters, byte_budget)
+        # factor layout from the model's own init schema (eval_shape: no
+        # FLOPs, no buffers) — GQA widths, scan stacking, d_ff all picked
+        # up without this module knowing the architecture
+        abstract = jax.eval_shape(
+            self.model.init,
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 1), jnp.int32),
+        )["params"]
+        self._factors = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), lora_tree(abstract)
+        )
+        # per-adapter resident bytes, metadata-only (registry accounting
+        # must not cost a device fetch — same rule as the prefix index)
+        self.adapter_nbytes = tree_nbytes(self._factors) // self.n_adapters
+
+    def register(self, name: str, factors) -> int:
+        """Admit ``name`` with its per-adapter factor tree
+        (:func:`.lora.extract_adapter` output) and write it into the
+        bank row the registry assigns. Raises ``RegistryFull`` /
+        ``ValueError`` synchronously — admission at registration."""
+        aid = self.registry.register(name, self.adapter_nbytes)
+
+        def put(bank_leaf, row):
+            row = jnp.asarray(row)
+            want = bank_leaf.shape[:-3] + bank_leaf.shape[-2:]
+            if row.shape != want:
+                raise ValueError(
+                    f"factor shape {row.shape} != expected {want}"
+                )
+            return bank_leaf.at[..., aid, :, :].set(
+                row.astype(bank_leaf.dtype)
+            )
+
+        try:
+            self._factors = jax.tree_util.tree_map(
+                put, self._factors, factors
+            )
+        except (ValueError, TypeError):
+            self.registry.evict(name)  # roll back the row grant
+            raise
+        return aid
+
+    def evict(self, name: str) -> int:
+        """Free ``name``'s row and zero its factors (requests carrying
+        the old id fall back to exact base-model behavior)."""
+        aid = self.registry.evict(name)
+        self._factors = jax.tree_util.tree_map(
+            lambda leaf: leaf.at[..., aid, :, :].set(0.0), self._factors
+        )
+        return aid
+
+    def row_zeros(self):
+        """A zeroed per-adapter factor tree in :meth:`register`'s row
+        shape (each leaf drops the adapter axis) — the template synthetic
+        tenants (examples, selftests) fill in."""
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.zeros(
+                leaf.shape[:-3] + leaf.shape[-2:], leaf.dtype
+            ),
+            self._factors,
+        )
+
+    def check_id(self, aid: int) -> int:
+        """Admission check for ``Request.adapter``: 0 (base) is always
+        valid; any other id must be a live registered row."""
+        aid = int(aid)
+        if not 0 <= aid < self.n_adapters:
+            raise ValueError(
+                f"adapter id {aid} out of range [0, {self.n_adapters})"
+            )
+        if not self.registry.is_live(aid):
+            raise ValueError(f"adapter id {aid} is not registered")
+        return aid
+
+    def merge_params(self, base_params):
+        """Base params + the bank's factor subtrees, one tree — what the
+        LoRA twin ``self.model`` applies. Factor arrays are functionally
+        updated by register/evict, so engines must re-merge after a
+        registration (``ServeEngine.refresh_adapters``)."""
+        return _deep_merge(base_params, self._factors)
+
+    def stats(self) -> dict:
+        return {
+            **self.registry.stats(),
+            "lora_rank": self.rank,
+            "adapter_nbytes": self.adapter_nbytes,
+        }
+
+
+def _deep_merge(base, extra) -> dict:
+    """Recursive dict merge (plain-dict output; accepts FrozenDicts)."""
+    out = {str(k): v for k, v in base.items()}
+    for k, v in extra.items():
+        k = str(k)
+        if k in out and hasattr(v, "items") and hasattr(out[k], "items"):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

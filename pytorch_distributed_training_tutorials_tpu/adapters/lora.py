@@ -1,0 +1,143 @@
+"""Training-side LoRA: init, trainable mask, extract, merge.
+
+The serving side gathers stacked factors per slot (:mod:`.bank`); this
+module is the tenant-producing side of the lifecycle: take a base
+:class:`..models.transformer.TransformerLM`, rebuild it with
+``TransformerConfig(lora_adapters=N, lora_rank=r)`` (every projection
+grows a zero-init ``*_lora`` sibling — base kernels, paths, and
+checkpoints untouched), random-init the A factors (:func:`lora_init`),
+train with the optimizer masked to the factor leaves
+(:func:`lora_param_mask` + ``optax.masked`` or
+``ops.fused_optim.fused_adamw(mask=...)``) and every batch tagged with
+the tenant's ``adapter_ids``, then :func:`extract_adapter` the trained
+row into an :class:`.bank.AdapterBank` entry — or :func:`merge_adapter`
+it into a standalone base-layout checkpoint.
+
+Why A-random/B-zero init: both factors start as zeros in the module (the
+id-0-is-base contract), but zero x zero is a saddle — ``dL/dA ∝ B`` and
+``dL/dB ∝ x @ A`` both vanish. Filling A's tenant rows (row 0 stays
+zero) keeps the initial forward EXACTLY the base model (B is still zero)
+while giving B a nonzero gradient from step one — the standard LoRA
+init, with the alpha scale folded into B's learned magnitude.
+"""
+
+from __future__ import annotations
+
+import math
+import zlib
+from collections.abc import Mapping
+
+import jax
+import jax.numpy as jnp
+
+LORA_SUFFIX = "_lora"
+
+
+def _key_str(k) -> str:
+    return str(getattr(k, "key", getattr(k, "idx", k)))
+
+
+def lora_tree(params) -> dict:
+    """The ``*_lora`` factor subtrees of ``params``, structure preserved
+    (plain dicts) — the bank's whole-bank factor layout."""
+
+    def walk(tree):
+        out = {}
+        for k, v in tree.items():
+            if not isinstance(v, Mapping):
+                continue
+            if str(k).endswith(LORA_SUFFIX):
+                out[str(k)] = dict(v)
+            else:
+                sub = walk(v)
+                if sub:
+                    out[str(k)] = sub
+        return out
+
+    return walk(params)
+
+
+def lora_param_mask(params):
+    """Boolean pytree over ``params`` (same treedef): True exactly on
+    leaves under a ``*_lora`` module — the trainable set. Pass as the
+    ``mask`` of ``optax.masked`` / ``fused_adamw`` so a fine-tune updates
+    ONLY the factors; base leaves stay bitwise untouched (masked
+    transforms never see them)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: any(
+            _key_str(k).endswith(LORA_SUFFIX) for k in path
+        ),
+        params,
+    )
+
+
+def lora_init(params, rng, stddev: float | None = None):
+    """Random-init every ``lora_a`` tenant row (rows ``1..N-1``; row 0 —
+    the base adapter — stays zero, as does all of ``lora_b``), leaving
+    every non-LoRA leaf untouched. ``stddev`` defaults to
+    ``1 / sqrt(d_in)`` per leaf. Deterministic per leaf: the key is
+    ``rng`` folded with a path hash, so layouts agree run to run."""
+
+    def init_leaf(path, leaf):
+        names = [_key_str(k) for k in path]
+        if names[-1] != "lora_a" or not any(
+            n.endswith(LORA_SUFFIX) for n in names
+        ):
+            return leaf
+        key = jax.random.fold_in(
+            rng, zlib.crc32("/".join(names).encode()) & 0x7FFFFFFF
+        )
+        std = stddev if stddev is not None else 1.0 / math.sqrt(
+            leaf.shape[-2]
+        )
+        rows = jax.random.normal(key, leaf.shape, leaf.dtype) * std
+        return rows.at[..., 0, :, :].set(0.0)  # adapter axis is -3
+
+    return jax.tree_util.tree_map_with_path(init_leaf, params)
+
+
+def extract_adapter(params, aid: int) -> dict:
+    """Slice adapter ``aid``'s factor rows out of a trained lora params
+    tree: the per-adapter entry :meth:`.bank.AdapterBank.register` takes
+    (each leaf loses its adapter axis — ``(..., N, d, r) -> (..., d,
+    r)``)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf[..., aid, :, :], lora_tree(params)
+    )
+
+
+def merge_adapter(params, aid: int) -> dict:
+    """Fold adapter ``aid``'s delta into the base kernels and DROP the
+    factor leaves: a params tree for the LoRA-free base model.
+
+    The merged forward matches the adapter-applied forward to float
+    tolerance only — NOT bitwise: ``x @ (W + A B)`` reassociates the sums
+    of ``x @ W + (x @ A) @ B`` — so parity checks belong on logits
+    (allclose), not tokens. Kernel shapes are restored by reshape: every
+    hooked projection contracts its flattened output/input dims the same
+    way its ``DenseGeneral`` stores them (row-major), on both the
+    unrolled and ``scan_layers``-stacked (leading ``(L,)``) layouts."""
+
+    def walk(tree):
+        out = {}
+        for k, v in tree.items():
+            name = str(k)
+            if name.endswith(LORA_SUFFIX):
+                continue
+            if isinstance(v, Mapping):
+                sub = dict(walk(v))
+                ln = tree.get(name + LORA_SUFFIX)
+                if ln is not None:
+                    a = ln["lora_a"][..., aid, :, :]
+                    b = ln["lora_b"][..., aid, :, :]
+                    delta = jnp.einsum("...ir,...ro->...io", a, b)
+                    kern = sub["kernel"]
+                    sub["kernel"] = (
+                        kern + delta.reshape(kern.shape)
+                    ).astype(kern.dtype)
+                out[name] = sub
+            else:
+                out[name] = v
+        return out
+
+    return walk(params)

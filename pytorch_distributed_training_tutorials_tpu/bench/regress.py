@@ -66,6 +66,12 @@ CONFIG_FIELDS = (
     # fingerprint on purpose, it is a workload-dependent outcome, not
     # part of the configuration
     "spec_k", "spec_ngram", "speculative",
+    # multi-tenant LoRA serving: bank geometry changes the measurement
+    # (per-slot factor gathers in every forward), so adapter rounds and
+    # base rounds are different experiments; occupancy/traffic counters
+    # (adapters_registered, adapter_requests) stay out — workload
+    # outcomes, not configuration
+    "n_adapters", "lora_rank", "adapters",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

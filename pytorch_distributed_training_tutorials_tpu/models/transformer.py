@@ -104,6 +104,16 @@ class TransformerConfig:
     # float TP rules — parallel.tensor_parallel.spec_for_path drops the
     # axis shape-aware). None = single-device / replicated serving.
     int8_mesh: "jax.sharding.Mesh | None" = None
+    # Multi-tenant LoRA (adapters/): > 0 equips every attention/MLP
+    # projection with a stacked (lora_adapters, ..., lora_rank) delta bank
+    # gathered per batch row by an adapter-id VECTOR inside the compiled
+    # program (adapters.bank.apply_lora) — row 0 is the base model (zero
+    # factors, kept zero by construction), so heterogeneous tenants
+    # co-batch in one program with no recompile: ids are data, only
+    # lora_adapters/lora_rank are static. 0 = feature off: params and
+    # compiled programs are byte-identical to a build without LoRA.
+    lora_adapters: int = 0
+    lora_rank: int = 0
 
     @property
     def ff_dim(self) -> int:
@@ -331,6 +341,44 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return masked_attention(q, k, v, mask)
 
 
+class LoRADelta(nn.Module):
+    """Stacked multi-tenant LoRA delta for ONE base projection.
+
+    Declares the whole bank as two params — ``lora_a`` ``(n_adapters,
+    d_in, rank)`` and ``lora_b`` ``(n_adapters, rank, d_out)`` — and
+    returns each batch row's low-rank delta ``(x @ A[id]) @ B[id]``,
+    gathering the row's factors by its adapter id inside the compiled
+    program (:func:`..adapters.bank.apply_lora`; ``jnp.take``, never a
+    Python branch on the traced id). Zero init is a contract, not a
+    convenience: adapter 0 IS the base model, and unregistered rows stay
+    exactly zero, so their delta is an exact ``0.0`` and base-tenant
+    outputs are token-identical to a LoRA-free build. Scaling (alpha) is
+    folded into ``lora_b`` by the training side — no separate knob here.
+    """
+
+    n_adapters: int
+    rank: int
+    d_in: int
+    d_out: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, adapter_ids):
+        from pytorch_distributed_training_tutorials_tpu.adapters.bank import (
+            apply_lora,
+        )
+
+        a = self.param(
+            "lora_a", nn.initializers.zeros,
+            (self.n_adapters, self.d_in, self.rank),
+        )
+        b = self.param(
+            "lora_b", nn.initializers.zeros,
+            (self.n_adapters, self.rank, self.d_out),
+        )
+        return apply_lora(x, a, b, adapter_ids, dtype=self.dtype)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -372,7 +420,10 @@ class Attention(nn.Module):
         return cached_k, cached_v, idx, k_scale, v_scale
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, prefill: bool = False):
+    def __call__(
+        self, x, decode: bool = False, prefill: bool = False,
+        adapter_ids=None,
+    ):
         cfg = self.cfg
         assert not (decode and prefill), "decode and prefill are exclusive"
         h, kv, d = cfg.n_heads, cfg.kv_heads, cfg.head_dim
@@ -403,6 +454,22 @@ class Attention(nn.Module):
         q_raw = proj("q_proj", h)(x)
         k_raw = proj("k_proj", kv)(x)  # GQA: only kv_heads cached/projected
         v = proj("v_proj", kv)(x)
+        if cfg.lora_adapters:
+            # per-row LoRA deltas on the raw projections (id 0 / any
+            # unregistered row adds an exact 0.0 — see LoRADelta)
+            lora = lambda name, dout: LoRADelta(  # noqa: E731
+                cfg.lora_adapters, cfg.lora_rank, cfg.d_model, dout,
+                dtype=cfg.dtype, name=name,
+            )
+            q_raw = q_raw + lora("q_proj_lora", h * d)(
+                x, adapter_ids
+            ).reshape(q_raw.shape)
+            k_raw = k_raw + lora("k_proj_lora", kv * d)(
+                x, adapter_ids
+            ).reshape(k_raw.shape)
+            v = v + lora("v_proj_lora", kv * d)(
+                x, adapter_ids
+            ).reshape(v.shape)
 
         if decode:
             # incremental decoding: S tokens in (S == 1 for the classic
@@ -536,14 +603,23 @@ class Attention(nn.Module):
                 # the Pallas flash kernel) handle any length. (ADVICE r3)
                 attn = causal_attention
             out = attn(q, k_attn, v_attn)
-        return out_proj(out)
+        y = out_proj(out)
+        if cfg.lora_adapters:
+            # o_proj delta reads the flattened attention context — same
+            # (H*D -> d_model) contraction as the base row-parallel matmul
+            flat = out.reshape(out.shape[0], out.shape[1], h * d)
+            y = y + LoRADelta(
+                cfg.lora_adapters, cfg.lora_rank, h * d, cfg.d_model,
+                dtype=cfg.dtype, name="o_proj_lora",
+            )(flat, adapter_ids)
+        return y
 
 
 class SwiGLU(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ids=None):
         cfg = self.cfg
         if cfg.quantized:
             from pytorch_distributed_training_tutorials_tpu.ops.quant import Int8Dense
@@ -557,9 +633,26 @@ class SwiGLU(nn.Module):
             dense = lambda f, name, kind: nn.Dense(  # noqa: E731
                 f, use_bias=False, dtype=cfg.dtype, name=name
             )
-        gate = nn.silu(dense(cfg.ff_dim, "gate_proj", "column")(x))
+        gate_pre = dense(cfg.ff_dim, "gate_proj", "column")(x)
         up = dense(cfg.ff_dim, "up_proj", "column")(x)
-        return dense(cfg.d_model, "down_proj", "row")(gate * up)
+        if cfg.lora_adapters:
+            lora = lambda name, din, dout: LoRADelta(  # noqa: E731
+                cfg.lora_adapters, cfg.lora_rank, din, dout,
+                dtype=cfg.dtype, name=name,
+            )
+            gate_pre = gate_pre + lora(
+                "gate_proj_lora", cfg.d_model, cfg.ff_dim
+            )(x, adapter_ids)
+            up = up + lora(
+                "up_proj_lora", cfg.d_model, cfg.ff_dim
+            )(x, adapter_ids)
+        hidden = nn.silu(gate_pre) * up
+        y = dense(cfg.d_model, "down_proj", "row")(hidden)
+        if cfg.lora_adapters:
+            y = y + lora(
+                "down_proj_lora", cfg.ff_dim, cfg.d_model
+            )(hidden, adapter_ids)
+        return y
 
 
 def _remat_policy(cfg: TransformerConfig):
@@ -591,12 +684,18 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, prefill: bool = False):
+    def __call__(
+        self, x, decode: bool = False, prefill: bool = False,
+        adapter_ids=None,
+    ):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(cfg.norm_eps, name="attn_norm")(x), decode=decode, prefill=prefill
+            RMSNorm(cfg.norm_eps, name="attn_norm")(x), decode=decode,
+            prefill=prefill, adapter_ids=adapter_ids,
         )
         if cfg.moe_experts > 0:
+            # MoE blocks carry no LoRA hooks (TransformerLM rejects the
+            # combination up front)
             ffn = MoEFFN(
                 num_experts=cfg.moe_experts,
                 top_k=cfg.moe_top_k,
@@ -606,9 +705,10 @@ class Block(nn.Module):
                 group_size=cfg.moe_group_size,
                 name="moe",
             )
-        else:
-            ffn = SwiGLU(cfg, name="mlp")
-        return x + ffn(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+            return x + ffn(RMSNorm(cfg.norm_eps, name="mlp_norm")(x))
+        return x + SwiGLU(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, name="mlp_norm")(x), adapter_ids
+        )
 
 
 class _ScanCell(nn.Module):
@@ -619,9 +719,12 @@ class _ScanCell(nn.Module):
     prefill: bool = False
 
     @nn.compact
-    def __call__(self, x, _):
+    def __call__(self, x, ids):
+        # ``ids`` is the scan's nn.broadcast input: the per-row adapter-id
+        # vector handed WHOLE to every layer (None when lora is off — an
+        # empty pytree, so the scanned program is unchanged)
         return Block(self.cfg, name="block")(
-            x, decode=self.decode, prefill=self.prefill
+            x, decode=self.decode, prefill=self.prefill, adapter_ids=ids
         ), None
 
 
@@ -643,6 +746,7 @@ class TransformerLM(nn.Module):
         prefill: bool = False,
         return_hidden: bool = False,
         last_pos=None,
+        adapter_ids=None,
     ):
         cfg = self.cfg
         if cfg.quantized and cfg.moe_experts:
@@ -654,6 +758,26 @@ class TransformerLM(nn.Module):
                 f"sequence length {tokens.shape[1]} exceeds "
                 f"max_seq_len {cfg.max_seq_len}"
             )
+        if adapter_ids is not None and not cfg.lora_adapters:
+            raise ValueError(
+                "adapter_ids passed but cfg.lora_adapters == 0 — build "
+                "with TransformerConfig(lora_adapters=N, lora_rank=r)"
+            )
+        if cfg.lora_adapters:
+            if cfg.moe_experts:
+                raise ValueError(
+                    "LoRA adapters support dense blocks only (no MoE)"
+                )
+            # the adapter id is DATA (a traced per-row vector — scalar ids
+            # broadcast over the batch); rows default to the base adapter
+            ids = jnp.broadcast_to(
+                jnp.asarray(
+                    0 if adapter_ids is None else adapter_ids, jnp.int32
+                ),
+                (tokens.shape[0],),
+            )
+        else:
+            ids = None
         x = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="tok_emb"
         )(tokens)
@@ -667,12 +791,14 @@ class TransformerLM(nn.Module):
                 cell,
                 # 'losses' rides along axis 0 so per-layer sown values (MoE
                 # load balancing) survive the scan instead of being dropped;
-                # 'cache' stacks each layer's KV cache the same way
+                # 'cache' stacks each layer's KV cache the same way; the
+                # adapter-id vector (or None) broadcasts to every layer
                 variable_axes={"params": 0, "losses": 0, "cache": 0},
                 split_rngs={"params": True},
+                in_axes=nn.broadcast,
                 length=cfg.n_layers,
             )(cfg, decode, prefill, name="layers")
-            x, _ = stack(x, None)
+            x, _ = stack(x, ids)
         else:
             # decode/prefill are Python bools steering cache behavior — they
             # must stay static under remat (args 2/3 of __call__ incl. self)
@@ -684,7 +810,14 @@ class TransformerLM(nn.Module):
                 else Block
             )
             for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f"block_{i}")(x, decode, prefill)
+                if ids is None:
+                    x = block_cls(cfg, name=f"block_{i}")(x, decode, prefill)
+                else:
+                    # adapter_ids is positional arg 4 — TRACED (remat's
+                    # static_argnums stays (2, 3): decode/prefill only)
+                    x = block_cls(cfg, name=f"block_{i}")(
+                        x, decode, prefill, ids
+                    )
         if prefill or (decode and last_pos is not None):
             # only the last position's logits feed the next-token sample;
             # skip the (P-1) discarded lm_head rows — at serving widths the

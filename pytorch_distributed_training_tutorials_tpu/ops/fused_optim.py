@@ -113,12 +113,21 @@ def fused_adamw(
     eps: float = 1e-8,
     weight_decay: float = 1e-4,
     *,
+    mask=None,
     block_rows: int = 1024,
     interpret: bool | None = None,
 ) -> optax.GradientTransformation:
     """Drop-in ``optax.adamw`` with the update fused to one kernel pass
     per leaf (same defaults and update math as ``optax.adamw``; decay is
-    applied to every leaf — no mask argument).
+    applied to every updated leaf).
+
+    ``mask`` (a boolean pytree matching params, or a callable producing
+    one — e.g. :func:`..adapters.lora.lora_param_mask`) restricts the
+    update to the True leaves: masked-out leaves get a hard-zero update
+    (``optax.set_to_zero``, not a pass-through of the raw gradient) AND
+    no moment buffers — a LoRA fine-tune pays optimizer memory only for
+    the factor leaves, exactly like ``optax.masked(optax.adamw(...),
+    mask)``.
 
     ``learning_rate`` must be a static float (it is baked into the
     kernel); schedules would need a per-step scalar operand — wrap with
@@ -176,4 +185,19 @@ def fused_adamw(
         new_v = jax.tree_util.tree_unflatten(treedef, [f[2] for f in flat])
         return new_u, FusedAdamWState(count=count, mu=new_m, nu=new_v)
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    tx = optax.GradientTransformation(init_fn, update_fn)
+    if mask is None:
+        return tx
+
+    def inverted(params):
+        m = mask(params) if callable(mask) else mask
+        return jax.tree_util.tree_map(lambda b: not b, m)
+
+    # masked kernel on the trainable leaves + hard zero on the frozen
+    # ones: apply_updates then adds exact 0.0, so frozen leaves never
+    # drift (a bare optax.masked would pass the RAW GRADIENT through as
+    # the masked-out "update")
+    return optax.chain(
+        optax.masked(tx, mask),
+        optax.masked(optax.set_to_zero(), inverted),
+    )

@@ -22,6 +22,12 @@ unchanged (the (S, T, k+1) block + counts ride the chain's ONE batched
 fetch), and the MECHANISM must have fired — mean accepted length > 1
 and sequential verify forwards strictly below tokens emitted (the whole
 point of speculation: fewer sequential decode steps than tokens).
+A fourth (``--adapters``) arm registers N-1 tenants with distinct LoRA
+factors into an :class:`..adapters.AdapterBank` and replays a
+mixed-tenant stream: every request's greedy tokens must be byte-identical
+to a DEDICATED single-tenant engine over the same bank, id-0 requests
+byte-identical to the bank-less base engine, the fetch budget unchanged,
+and admission of an unregistered id must fail synchronously at submit.
 Prints exactly one JSON line (a ``graft-receipt/v1`` envelope) and
 exits non-zero on any failure.
 """
@@ -34,10 +40,16 @@ import os
 import sys
 
 
-def selftest(json_path: str | None = None, spec_k: int = 2) -> dict:
+def selftest(json_path: str | None = None, spec_k: int = 2,
+             adapters: int = 3) -> dict:
     import jax
     import jax.numpy as jnp
 
+    from pytorch_distributed_training_tutorials_tpu.adapters import (
+        AdapterBank,
+        extract_adapter,
+        lora_init,
+    )
     from pytorch_distributed_training_tutorials_tpu.models.generate import generate
     from pytorch_distributed_training_tutorials_tpu.models.transformer import (
         TransformerConfig,
@@ -282,6 +294,123 @@ def selftest(json_path: str | None = None, spec_k: int = 2) -> dict:
             f"saved no sequential steps"
         )
 
+    # ------------------------------------------------------------------
+    # multi-tenant adapter arm: N-1 tenants with distinct LoRA factors in
+    # one bank; a mixed-tenant stream must match dedicated single-tenant
+    # engines per request (one compiled program serves them all), id 0
+    # must match the BANK-LESS base engine, the fetch budget is
+    # unchanged, and unregistered ids are rejected at submit
+    # ------------------------------------------------------------------
+    bank = AdapterBank(model, n_adapters=adapters, rank=4)
+    lparams = lora_init(
+        bank.model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+        )["params"],
+        jax.random.PRNGKey(5),
+    )
+
+    def fill_b(path, leaf):  # lora_init leaves B zero; tenants need deltas
+        if str(getattr(path[-1], "key", path[-1])) != "lora_b":
+            return leaf
+        v = jax.random.normal(
+            jax.random.PRNGKey(11), leaf.shape, leaf.dtype
+        ) * 0.05
+        return v.at[..., 0, :, :].set(0.0)
+
+    lparams = jax.tree_util.tree_map_with_path(fill_b, lparams)
+    base_row = extract_adapter(lparams, 1)
+    for aid in range(1, adapters):
+        # distinct factors per tenant (scaled copies — cheap, different)
+        bank.register(f"tenant-{aid}", jax.tree_util.tree_map(
+            lambda x, s=aid: x * (1.0 if s % 2 else -1.0) / s, base_row
+        ))
+
+    tenant_reqs = []  # (prompt, max_new, adapter id) — ids interleaved
+    for i, (toks, max_new) in enumerate(prompts):
+        tenant_reqs.append((toks, max_new, i % adapters))
+
+    def run_tenant_stream(reqs, with_bank):
+        eng = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=8,
+            adapter_bank=bank if with_bank else None,
+        )
+        count = {"n": 0}
+
+        def counting(x):
+            count["n"] += 1
+            return real_get(x)
+
+        jax.device_get = counting
+        try:
+            out = {}
+            pending = list(reqs)
+            for toks, max_new, aid in pending[:2]:
+                eng.submit(Request(
+                    prompt=toks, max_new_tokens=max_new, adapter=aid
+                ))
+            pending = pending[2:]
+            while not eng.idle or pending:
+                while pending:
+                    toks, max_new, aid = pending[0]
+                    try:
+                        eng.submit(Request(
+                            prompt=toks, max_new_tokens=max_new,
+                            adapter=aid,
+                        ))
+                        pending.pop(0)
+                    except QueueFull:
+                        break
+                for c in eng.step():
+                    out[c.request_id] = c.tokens
+        finally:
+            jax.device_get = real_get
+        return eng, out, count["n"]
+
+    eng_mix, toks_mix, fetches_mix = run_tenant_stream(tenant_reqs, True)
+    adapter_exact = True
+    for aid in range(adapters):
+        idx = [i for i, r in enumerate(tenant_reqs) if r[2] == aid]
+        if not idx:
+            continue
+        solo_reqs = [tenant_reqs[i] for i in idx]
+        _, toks_solo, _ = run_tenant_stream(solo_reqs, True)
+        got = [toks_mix[i] for i in idx]
+        want = [toks_solo[j] for j in sorted(toks_solo)]
+        if got != want:
+            adapter_exact = False
+            problems.append(
+                f"adapter {aid}: mixed-tenant tokens {got} != "
+                f"dedicated-engine tokens {want}"
+            )
+    # id 0 through the bank == the bank-less base engine (zero factors
+    # are EXACTLY the base model, not approximately)
+    base_idx = [i for i, r in enumerate(tenant_reqs) if r[2] == 0]
+    base_got = [toks_mix[i] for i in base_idx]
+    base_want = [completions[i].tokens for i in base_idx]
+    if base_got != base_want:
+        adapter_exact = False
+        problems.append(
+            f"adapter 0 tokens {base_got} != base engine {base_want}"
+        )
+    mix_budget = eng_mix.n_chains + eng_mix.n_prefills
+    if fetches_mix > mix_budget:
+        problems.append(
+            f"adapter arm: {fetches_mix} host fetches > {mix_budget} "
+            f"({eng_mix.n_chains} chains + {eng_mix.n_prefills} prefills)"
+        )
+    try:
+        eng_mix.submit(Request(
+            prompt=[1, 2], max_new_tokens=2, adapter=adapters,
+        ))
+        problems.append(
+            f"unregistered adapter id {adapters} admitted at submit"
+        )
+    except ValueError:
+        pass
+    astats = eng_mix.adapter_stats()
+    if astats.get("adapter_requests", 0) < 1:
+        problems.append(f"no tenant traffic recorded: {astats}")
+
     receipt = make_receipt(
         "serve_selftest",
         {
@@ -304,6 +433,10 @@ def selftest(json_path: str | None = None, spec_k: int = 2) -> dict:
             "spec_generated_tokens": eng_spec.generated_tokens,
             "spec_host_fetches": fetches_spec,
             **sstats,
+            "adapter_requests_total": len(tenant_reqs),
+            "adapter_token_exact": adapter_exact,
+            "adapter_host_fetches": fetches_mix,
+            **astats,
             "problems": problems,
             "ok": not problems,
         },
@@ -331,6 +464,11 @@ def main(argv: list[str] | None = None) -> int:
         "--spec-k", type=int, default=2,
         help="speculate-k for the speculative selftest arm (>= 1)",
     )
+    parser.add_argument(
+        "--adapters", type=int, default=3,
+        help="bank rows for the multi-tenant selftest arm (>= 2; "
+        "rows 1..N-1 become tenants, row 0 is the base model)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -349,7 +487,8 @@ def main(argv: list[str] | None = None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    receipt = selftest(args.json, spec_k=args.spec_k)
+    receipt = selftest(args.json, spec_k=args.spec_k,
+                       adapters=args.adapters)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

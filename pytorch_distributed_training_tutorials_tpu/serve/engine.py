@@ -51,7 +51,17 @@ Orca-style (OSDI '22) fix, built TPU-native:
   see models/transformer.py). ``k`` is STATIC; the accepted length is
   *data*, so nothing recompiles and the chain still costs one launch +
   ONE batched fetch — it just returns an ``(n_slots, steps, k+1)``
-  token block plus per-step emit counts instead of one token per step.
+  token block plus per-step emit counts instead of one token per step;
+- with ``adapter_bank=...``, every slot carries a per-request LoRA
+  adapter id (:mod:`..adapters`): the bank's stacked factors ride in the
+  params tree, each slot's id is DATA gathered by
+  :func:`..adapters.bank.apply_lora` inside the same compiled programs,
+  so tenants with different adapters co-batch with zero recompiles and
+  id 0 (zero factors) is EXACTLY the base model. ``Request.adapter`` is
+  validated at :meth:`submit` (admission, like the window check); prefix
+  keys are namespaced per adapter so tenants never splice each other's
+  KV. Bank off keeps the state tree and compiled programs
+  byte-identical.
 
 Greedy decoding is token-exact vs one-shot ``generate()`` (same math,
 same cache semantics; pinned by tests/test_serve.py). Temperature /
@@ -62,6 +72,7 @@ every step; per-request randomness comes from per-request seeds.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 
 import jax
@@ -140,6 +151,7 @@ class ServeEngine:
         min_hit_depth: int = 1,
         speculative_k: int = 0,
         spec_ngram: int = 3,
+        adapter_bank=None,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -147,6 +159,27 @@ class ServeEngine:
             raise ValueError("tokens_per_launch must be >= 1")
         if speculative_k < 0:
             raise ValueError("speculative_k must be >= 0")
+        # adapter bank: None = off (the engine then builds byte-identical
+        # state and compiled programs to the adapter-free one). On, the
+        # engine serves the bank's LoRA twin of the caller's model over
+        # merged params (base tree + stacked factor subtrees); the base
+        # tree stays caller-owned and untouched.
+        self._bank = adapter_bank
+        self._adapters = adapter_bank is not None
+        if self._adapters:
+            base_cfg = dataclasses.replace(
+                model.cfg, lora_adapters=0, lora_rank=0
+            )
+            bank_base = dataclasses.replace(
+                adapter_bank.model.cfg, lora_adapters=0, lora_rank=0
+            )
+            if base_cfg != bank_base:
+                raise ValueError(
+                    "adapter_bank was built for a different model config"
+                )
+            self._base_params = params
+            model = adapter_bank.model
+            params = adapter_bank.merge_params(params)
         self.model = model
         self.params = params
         self.n_slots = n_slots
@@ -166,6 +199,7 @@ class ServeEngine:
         self._state = init_slot_state(
             model, params, n_slots,
             history=self.window if self._spec else 0,
+            adapters=self._adapters,
         )
         self._scan_layers = bool(getattr(model.cfg, "scan_layers", False))
         self._temperature = float(temperature)
@@ -200,6 +234,8 @@ class ServeEngine:
         self.n_verify_forwards = 0
         self.spec_steps_consumed = 0
         self.spec_drafts_accepted = 0
+        # requests served with a non-base adapter (receipt counter)
+        self.adapter_requests = 0
         # donating the state tree lets XLA update the multi-hundred-MB
         # cache in place; CPU jit warns on donation (unsupported), so
         # only donate where it is real
@@ -228,7 +264,7 @@ class ServeEngine:
     # ------------------------------------------------------------------
 
     def _prefill_fn(self, params, state, tokens, p_len, slot, seed,
-                    max_new):
+                    max_new, aid=0):
         """Prefill ``tokens`` (1, bucket) into slot ``slot``: one batched
         forward populates the slot's K/V for ``[0, p_len)``, the first
         token is sampled from the logits gathered at the last REAL prompt
@@ -236,14 +272,24 @@ class ServeEngine:
         ``slot`` / ``seed`` / ``max_new`` are traced scalars — one
         compile per prompt BUCKET, not per request.
 
+        ``aid`` (the request's adapter id) is only PASSED when the bank
+        is on — adapters off leave it the Python default 0, a jit-inert
+        constant, so the adapter-free jaxpr is byte-identical to the
+        pre-adapter engine's. On, it is a traced scalar threaded into the
+        forward as ``adapter_ids`` and recorded in the slot state for the
+        chain's per-slot gather.
+
         With the prefix cache on, the bucket-length leading chunk of the
         just-prefilled batch-1 cache rides out as a retained segment
         (:func:`.slots.extract_segment` — insert-on-prefill); ``()``
         otherwise, so the cache-off engine's compiled program is
         unchanged."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
         logits, upd = self.model.apply(
             {"params": params}, tokens, prefill=True, mutable=["cache"],
-            last_pos=p_len - 1,
+            last_pos=p_len - 1, **kw,
         )
         key = jax.random.PRNGKey(seed)
         first, key = sample_logits(
@@ -271,10 +317,14 @@ class ServeEngine:
             new_state.update(_seed_history(
                 state, tokens, p_len, slot, first[0]
             ))
+        if self._adapters:
+            new_state["adapter_ids"] = state["adapter_ids"].at[slot].set(
+                jnp.asarray(aid, jnp.int32)
+            )
         return new_state, first[0], seg
 
     def _splice_fn(self, params, state, segment, suffix, full, depth,
-                   p_len, slot, seed, max_new, *, seg_len, grow):
+                   p_len, slot, seed, max_new, aid=0, *, seg_len, grow):
         """Prefix-cache-hit refill: seed a batch-1 cache from a retained
         ``segment`` at ``depth`` reused positions, run ONE chunked decode
         over the bucket-padded ``suffix`` (1, s_bucket) — the suffix
@@ -295,11 +345,20 @@ class ServeEngine:
         ``full`` is the whole bucket-padded prompt (1, bucket) — the
         n-gram draft history must cover the REUSED prefix too, which
         ``suffix`` alone cannot seed. Speculation off passes the suffix
-        array again; the operand is then dead and XLA drops it."""
+        array again; the operand is then dead and XLA drops it.
+
+        ``aid`` follows the :meth:`_prefill_fn` contract (Python-default
+        0 when adapters are off, traced scalar when on). Splices only
+        ever reuse segments from the SAME adapter — ``_refill``
+        namespaces prefix keys per adapter — so the seeded prefix K/V
+        was computed under the same factors the suffix prefill applies."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
         cache1 = seed_cache(self._proto1, segment, depth)
         logits, upd = self.model.apply(
             {"params": params, "cache": cache1}, suffix, decode=True,
-            mutable=["cache"], last_pos=p_len - 1 - depth,
+            mutable=["cache"], last_pos=p_len - 1 - depth, **kw,
         )
         key = jax.random.PRNGKey(seed)
         first, key = sample_logits(
@@ -324,6 +383,10 @@ class ServeEngine:
             new_state.update(_seed_history(
                 state, full, p_len, slot, first[0]
             ))
+        if self._adapters:
+            new_state["adapter_ids"] = state["adapter_ids"].at[slot].set(
+                jnp.asarray(aid, jnp.int32)
+            )
         return new_state, first[0], seg
 
     def _chain_fn(self, params, state):
@@ -333,14 +396,24 @@ class ServeEngine:
         K/V writes land at advancing positions whose reads are never
         consumed (and drop once past the window — ``_store_decode_kv``
         in models/transformer.py), and refill rewrites the whole slot
-        anyway."""
+        anyway.
+
+        With the adapter bank on, the per-slot adapter-id vector rides
+        into every step as a scan CONSTANT (refill — the only writer —
+        runs between chains), and each step's forward gathers each
+        slot's factors by it (:func:`..adapters.bank.apply_lora`):
+        heterogeneous tenants decode together in this one program."""
+        kw = (
+            {"adapter_ids": state["adapter_ids"]}
+            if self._adapters else {}
+        )
 
         def step(carry, _):
             cache, tok, keys, remaining = carry
             active = remaining > 0
             logits, upd = self.model.apply(
                 {"params": params, "cache": cache}, tok[:, None],
-                decode=True, mutable=["cache"],
+                decode=True, mutable=["cache"], **kw,
             )
             nxt, keys = sample_logits_per_slot(
                 logits[:, -1].astype(jnp.float32), keys,
@@ -357,11 +430,13 @@ class ServeEngine:
         (cache, tok, keys, remaining), toks = jax.lax.scan(
             step, carry, None, length=self.tokens_per_launch
         )
-        state = {
+        out = {
             "cache": cache, "last_tok": tok, "keys": keys,
             "remaining": remaining,
         }
-        return state, toks.T  # (n_slots, tokens_per_launch)
+        if self._adapters:
+            out["adapter_ids"] = state["adapter_ids"]
+        return out, toks.T  # (n_slots, tokens_per_launch)
 
     def _spec_chain_fn(self, params, state):
         """Speculate-k decode chain: ``tokens_per_launch`` iterations of
@@ -392,6 +467,11 @@ class ServeEngine:
         rows = jnp.arange(self.n_slots)
         offs = jnp.arange(k + 1)
         win = self.window
+        # same scan-constant contract as _chain_fn
+        kw = (
+            {"adapter_ids": state["adapter_ids"]}
+            if self._adapters else {}
+        )
 
         def step(carry, _):
             cache, tok, keys, remaining, hist, hist_len = carry
@@ -400,7 +480,7 @@ class ServeEngine:
             toks_in = jnp.concatenate([tok[:, None], draft], axis=1)
             logits, upd = self.model.apply(
                 {"params": params, "cache": cache}, toks_in,
-                decode=True, mutable=["cache"],
+                decode=True, mutable=["cache"], **kw,
             )
             emitted, n_acc, keys = speculative_accept(
                 logits.astype(jnp.float32), draft, keys,
@@ -432,12 +512,14 @@ class ServeEngine:
         (cache, tok, keys, remaining, hist, hist_len), (toks, counts) = (
             jax.lax.scan(step, carry, None, length=self.tokens_per_launch)
         )
-        state = {
+        out = {
             "cache": cache, "last_tok": tok, "keys": keys,
             "remaining": remaining, "hist": hist, "hist_len": hist_len,
         }
+        if self._adapters:
+            out["adapter_ids"] = state["adapter_ids"]
         # (S, T, k+1) token block + (S, T) counts
-        return state, (jnp.transpose(toks, (1, 0, 2)), counts.T)
+        return out, (jnp.transpose(toks, (1, 0, 2)), counts.T)
 
     # ------------------------------------------------------------------
     # host-side driver
@@ -447,7 +529,17 @@ class ServeEngine:
         """Enqueue one request; returns its id. Raises
         :class:`..serve.scheduler.QueueFull` when the bounded queue is at
         capacity (backpressure) or ``ValueError`` when the request can
-        never fit the window."""
+        never fit the window — or names an adapter this engine cannot
+        serve (no bank, or an unregistered/out-of-range id): admission
+        failures are always synchronous, never a mid-decode surprise."""
+        aid = int(getattr(request, "adapter", 0))
+        if aid != 0 and not self._adapters:
+            raise ValueError(
+                f"request names adapter {aid} but the engine has no "
+                "adapter bank (pass ServeEngine(adapter_bank=...))"
+            )
+        if self._adapters:
+            self._bank.check_id(aid)
         return self.scheduler.submit(request)
 
     @property
@@ -508,16 +600,26 @@ class ServeEngine:
         prefix is inserted into the index (when not already resident),
         and a hit pins its donor segment until this request completes,
         so eviction only ever happens here, BETWEEN decode chains, and
-        never under a slot mid-decode."""
+        never under a slot mid-decode.
+
+        Prefix keys are NAMESPACED by the request's adapter id
+        (:meth:`_prefix_key`): a tenant's K/V depends on its factors, so
+        a cross-tenant splice would seed a slot with wrong-adapter
+        prefixes — disjoint key ranges make that lookup structurally
+        impossible while keeping the index itself adapter-oblivious."""
+        aid = int(getattr(req, "adapter", 0))
+        if aid:
+            self.adapter_requests += 1
         prompt = [int(t) for t in req.prompt]
         p_len = len(prompt)
         bucket = bucket_len(p_len, self.window)
+        pkey = self._prefix_key(prompt, aid)
         hit = (
-            self.prefix.lookup(prompt, self._min_hit_depth)
+            self.prefix.lookup(pkey, self._min_hit_depth)
             if self.prefix is not None
             else None
         )
-        grow = self.prefix is not None and tuple(prompt) not in self.prefix
+        grow = self.prefix is not None and tuple(pkey) not in self.prefix
         if hit is not None:
             depth, segment = hit
             suffix = prompt[depth:]
@@ -531,10 +633,13 @@ class ServeEngine:
                 if self._spec
                 else tokens  # dead operand when speculation is off
             )
+            # aid rides as a keyword ONLY when adapters are on: the off
+            # engine's call signature (and so its jaxpr) stays identical
+            akw = {"aid": aid} if self._adapters else {}
             self._state, first, new_seg = self._splice(
                 self.params, self._state, segment.handle, tokens, full,
                 depth, p_len, slot, req.seed, req.max_new_tokens,
-                seg_len=bucket, grow=grow,
+                seg_len=bucket, grow=grow, **akw,
             )
             self.n_splices += 1
             self.prefix_hit_tokens += depth
@@ -542,13 +647,14 @@ class ServeEngine:
             segment = None
             padded = prompt + [0] * (bucket - p_len)
             tokens = jnp.asarray([padded], jnp.int32)
+            akw = {"aid": aid} if self._adapters else {}
             self._state, first, new_seg = self._prefill(
                 self.params, self._state, tokens, p_len, slot, req.seed,
-                req.max_new_tokens,
+                req.max_new_tokens, **akw,
             )
             self.n_prefills += 1
         if grow:
-            self.prefix.insert(tuple(prompt), new_seg, tree_nbytes(new_seg))
+            self.prefix.insert(tuple(pkey), new_seg, tree_nbytes(new_seg))
         first = int(jax.device_get(first))
         self.generated_tokens += 1
         act = _Active(req, first)
@@ -566,6 +672,19 @@ class ServeEngine:
             return [self._complete(act, reason)]
         self._slots[slot] = act
         return []
+
+    def _prefix_key(self, prompt: list[int], aid: int) -> list[int]:
+        """Adapter-scoped prefix-index key: shift every token by
+        ``aid * vocab_size`` so tenants occupy disjoint key ranges —
+        same LPM depth within a tenant, zero matches across tenants.
+        Host-only arithmetic (the index never sees real token ids for
+        aid > 0, which is fine: keys are opaque to it); aid 0 keys are
+        the raw prompt, so base-model streams share the index exactly as
+        before the bank existed."""
+        if aid == 0:
+            return prompt
+        shift = aid * int(self.model.cfg.vocab_size)
+        return [t + shift for t in prompt]
 
     def _distribute(self, toks) -> list[Completion]:
         """Hand one fetched (S, T) chain block out to the slots' host
@@ -687,6 +806,36 @@ class ServeEngine:
                 1.0 + self.spec_drafts_accepted / steps,
             "spec_acceptance_rate":
                 self.spec_drafts_accepted / (steps * self._spec_k),
+        }
+
+    def refresh_adapters(self) -> None:
+        """Re-merge the bank's factors into the served params after a
+        :meth:`..adapters.bank.AdapterBank.register` / ``evict`` on a
+        LIVE engine. The factor arrays are functionally updated, so the
+        engine's merged tree must be rebuilt — shapes are unchanged, so
+        nothing recompiles. Call it between :meth:`step` rounds; requests
+        already decoding keep their slot's id but see the new factors
+        (register into a FREE row before serving it and this is a
+        non-event for in-flight traffic)."""
+        if not self._adapters:
+            raise ValueError("engine has no adapter bank")
+        self.params = self._bank.merge_params(self._base_params)
+
+    def adapter_stats(self) -> dict[str, int | float]:
+        """Multi-tenancy counters for the serving receipt (same pattern
+        as :meth:`spec_stats`): bank geometry + registry occupancy + how
+        much traffic ran under a non-base adapter. All host bookkeeping —
+        no device fetch."""
+        if not self._adapters:
+            return {"adapters": 0}
+        reg = self._bank.registry
+        return {
+            "adapters": 1,
+            "n_adapters": self._bank.n_adapters,
+            "lora_rank": self._bank.rank,
+            "adapters_registered": len(reg),
+            "adapter_requests": self.adapter_requests,
+            "adapter_bytes": reg.used_bytes,
         }
 
 

@@ -35,12 +35,21 @@ class Request:
     which other requests share the decode batch. ``eos_token`` stops the
     request early when sampled (the stop token is included in the
     output); ``None`` always runs to ``max_new_tokens``.
+
+    ``adapter`` names the tenant's LoRA bank row (0 = base model).
+    Validated at ``ServeEngine.submit`` against the engine's
+    :class:`..adapters.bank.AdapterBank` — an unknown/unregistered id is
+    a synchronous ``ValueError``, the same admission contract as the
+    window check — then carried as DATA through prefill/splice/refill
+    and the decode chain, so tenants with different adapters co-batch in
+    one compiled program.
     """
 
     prompt: Any
     max_new_tokens: int
     seed: int = 0
     eos_token: int | None = None
+    adapter: int = 0
     # engine-assigned bookkeeping (not caller inputs)
     request_id: int = -1
     submitted_s: float = 0.0

@@ -57,7 +57,8 @@ def bucket_len(p_len: int, window: int, floor: int = 8) -> int:
     return min(b, window)
 
 
-def init_slot_state(model, params, n_slots: int, history: int = 0):
+def init_slot_state(model, params, n_slots: int, history: int = 0,
+                    adapters: bool = False):
     """Zero-initialized slot-state pytree for ``n_slots`` concurrent
     requests of ``model`` (a :class:`..models.transformer.TransformerLM`
     or anything sharing its cache contract).
@@ -86,6 +87,13 @@ def init_slot_state(model, params, n_slots: int, history: int = 0):
     never costs a host round-trip. Speculation off keeps the state tree
     (and therefore every compiled program) byte-identical to the
     pre-speculation engine.
+
+    ``adapters=True`` (the engine passes it when an adapter bank is
+    attached) adds ``adapter_ids`` ``(S,)`` int32 — each slot's LoRA bank
+    row, set at prefill/splice and carried through the chain as the
+    per-row gather index of :func:`..adapters.bank.apply_lora`. Same
+    off-state contract as speculation: adapters off keeps the state tree
+    byte-identical.
     """
     if n_slots < 1:
         raise ValueError("n_slots must be >= 1")
@@ -114,6 +122,8 @@ def init_slot_state(model, params, n_slots: int, history: int = 0):
     if history > 0:
         state["hist"] = jnp.zeros((n_slots, history), jnp.int32)
         state["hist_len"] = jnp.zeros((n_slots,), jnp.int32)
+    if adapters:
+        state["adapter_ids"] = jnp.zeros((n_slots,), jnp.int32)
     return state
 
 

@@ -135,11 +135,17 @@ def _compute_loss(loss: str, logits, targets):
 
 
 def _make_loss_fn(
-    loss: str, has_batch_stats: bool, aux_loss_weight: float
+    loss: str, has_batch_stats: bool, aux_loss_weight: float,
+    model_kwargs: dict | None = None,
 ):
     """The single definition of the training objective, shared by the plain
     step, the epoch scan, and the gradient-accumulation step — one place
-    owns the batch_stats/mutable/aux-loss contract."""
+    owns the batch_stats/mutable/aux-loss contract.
+
+    ``model_kwargs`` are extra keywords forwarded verbatim to every model
+    apply — e.g. ``{"adapter_ids": tid}`` to pin a LoRA fine-tune
+    (:mod:`..adapters`) to one tenant row. They are closed over (trace-time
+    constants), not per-batch data."""
 
     fused = loss == "fused_cross_entropy"
 
@@ -147,7 +153,7 @@ def _make_loss_fn(
         x, y = batch
         variables = {"params": params}
         mutable = []
-        kwargs = {}
+        kwargs = dict(model_kwargs) if model_kwargs else {}
         if has_batch_stats:
             variables["batch_stats"] = state.batch_stats
             mutable.append("batch_stats")
@@ -195,11 +201,14 @@ def _train_step_fn(
     loss: str = "cross_entropy",
     has_batch_stats: bool = False,
     aux_loss_weight: float = 0.0,
+    model_kwargs: dict | None = None,
 ):
     """The raw (unjitted) SPMD train step, shared by :func:`make_train_step`
     (jit per step — streaming loaders) and :func:`make_epoch_scan` (one jit
     per epoch — device-resident datasets)."""
-    loss_fn = _make_loss_fn(loss, has_batch_stats, aux_loss_weight)
+    loss_fn = _make_loss_fn(
+        loss, has_batch_stats, aux_loss_weight, model_kwargs
+    )
 
     def step_fn(state: TrainState, batch):
         (loss_val, new_stats), grads = jax.value_and_grad(
@@ -217,6 +226,7 @@ def make_train_step(
     has_batch_stats: bool = False,
     aux_loss_weight: float = 0.0,
     grad_accum_steps: int = 1,
+    model_kwargs: dict | None = None,
 ):
     """Build the jitted SPMD train step (donated state).
 
@@ -243,16 +253,24 @@ def make_train_step(
     stay evenly spread over a ``data``-sharded batch, the *per-device* row
     count must also divide by ``grad_accum_steps`` (the Trainer validates
     this where the mesh width is known).
+
+    ``model_kwargs`` forwards extra trace-time keywords to every model
+    apply (see :func:`_make_loss_fn`) — the LoRA fine-tune path pins
+    ``{"adapter_ids": tid}`` this way.
     """
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
     if grad_accum_steps == 1:
         return jax.jit(
-            _train_step_fn(loss, has_batch_stats, aux_loss_weight),
+            _train_step_fn(
+                loss, has_batch_stats, aux_loss_weight, model_kwargs
+            ),
             donate_argnums=0,
         )
 
-    loss_fn = _make_loss_fn(loss, has_batch_stats, aux_loss_weight)
+    loss_fn = _make_loss_fn(
+        loss, has_batch_stats, aux_loss_weight, model_kwargs
+    )
 
     def step_fn(state: TrainState, batch):
         n = grad_accum_steps

@@ -193,6 +193,10 @@ def test_prefix_module_imports_no_jax():
         "import sys\n"
         "import pytorch_distributed_training_tutorials_tpu.serve.prefix\n"
         "import pytorch_distributed_training_tutorials_tpu.serve.scheduler\n"
+        # the adapter registry (tenant name -> bank row) and the lazy
+        # adapters package itself share the host-only contract (ISSUE 8)
+        "import pytorch_distributed_training_tutorials_tpu.adapters.registry\n"
+        "import pytorch_distributed_training_tutorials_tpu.adapters\n"
         "assert 'jax' not in sys.modules, 'prefix index must not import jax'\n"
     )
     env = {k: v for k, v in os.environ.items() if k != "PYTHONSTARTUP"}

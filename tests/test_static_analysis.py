@@ -255,6 +255,38 @@ def test_traced_control_flow_catches_python_branch_on_accepted_length():
     assert not hits(check(clean), "traced-control-flow")
 
 
+def test_traced_control_flow_catches_python_branch_on_adapter_id():
+    """The multi-tenant foot-gun (ISSUE 8): a slot's LoRA adapter id is
+    DATA inside the compiled decode chain — a Python branch selecting
+    per-tenant factors would force one compile per tenant mix (or just
+    crash on the tracer). The jnp.take gather twin (what
+    adapters.bank.apply_lora actually does) must stay silent."""
+    src = """
+        import jax
+
+        @jax.jit
+        def forward(x, factors, adapter_id):
+            if adapter_id > 0:          # per-slot adapter id is data!
+                x = x @ factors[1]
+            return x
+    """
+    found = hits(check(src), "traced-control-flow")
+    assert len(found) == 1 and found[0].line == 6
+
+    clean = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def forward(x, a, b, adapter_ids):
+            ai = jnp.take(a, adapter_ids, axis=0)   # gather, not branch
+            bi = jnp.take(b, adapter_ids, axis=0)
+            return x + jnp.einsum("bsr,bro->bso",
+                                  jnp.einsum("bsd,bdr->bsr", x, ai), bi)
+    """
+    assert not hits(check(clean), "traced-control-flow")
+
+
 # -------------------------------------------------------------- host-sync-hazard
 
 def test_host_sync_fires_inside_jit():
