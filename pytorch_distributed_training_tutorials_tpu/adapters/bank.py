@@ -90,6 +90,10 @@ class AdapterBank:
         self.n_adapters = int(n_adapters)
         self.rank = int(rank)
         self.registry = AdapterRegistry(n_adapters, byte_budget)
+        # bumped whenever the factor tree actually changes (register /
+        # evict) — engines compare it against the version they last
+        # merged and re-merge automatically at the next step()
+        self.version = 0
         # factor layout from the model's own init schema (eval_shape: no
         # FLOPs, no buffers) — GQA widths, scan stacking, d_ff all picked
         # up without this module knowing the architecture
@@ -130,6 +134,7 @@ class AdapterBank:
         except (ValueError, TypeError):
             self.registry.evict(name)  # roll back the row grant
             raise
+        self.version += 1
         return aid
 
     def evict(self, name: str) -> int:
@@ -139,6 +144,7 @@ class AdapterBank:
         self._factors = jax.tree_util.tree_map(
             lambda leaf: leaf.at[..., aid, :, :].set(0.0), self._factors
         )
+        self.version += 1
         return aid
 
     def row_zeros(self):
@@ -151,6 +157,14 @@ class AdapterBank:
             ),
             self._factors,
         )
+
+    def generation(self, aid: int) -> int:
+        """Tenant incarnation of row ``aid`` — see
+        :meth:`.registry.AdapterRegistry.generation`. The serve engine
+        folds it into prefix-cache keys and queued-request admission so a
+        recycled row can never serve (or splice) a previous tenant's
+        state."""
+        return self.registry.generation(int(aid))
 
     def check_id(self, aid: int) -> int:
         """Admission check for ``Request.adapter``: 0 (base) is always
@@ -167,8 +181,10 @@ class AdapterBank:
     def merge_params(self, base_params):
         """Base params + the bank's factor subtrees, one tree — what the
         LoRA twin ``self.model`` applies. Factor arrays are functionally
-        updated by register/evict, so engines must re-merge after a
-        registration (``ServeEngine.refresh_adapters``)."""
+        updated by register/evict (each bumps :attr:`version`); a live
+        engine notices the stale merge and re-merges automatically at its
+        next ``step()`` (``ServeEngine.refresh_adapters`` forces it
+        eagerly)."""
         return _deep_merge(base_params, self._factors)
 
     def stats(self) -> dict:

@@ -19,6 +19,12 @@ Contracts:
 - Eviction is EXPLICIT (``evict(name)``), never an LRU side effect: a
   tenant's weights disappearing because another registered would be a
   serving correctness bug, unlike a prefix segment (pure cache) aging out.
+- Rows are REUSED (lowest-free-first), so a bare row id does not identify
+  a tenant across evict/register cycles: every ``register`` bumps the
+  row's GENERATION counter (``generation(aid)``), and anything keyed or
+  captured per tenant — prefix-cache namespaces, queued requests — must
+  carry ``(aid, generation)``, never the row id alone. Row 0 (base) is
+  never reassigned, so its generation stays 0 forever.
 - Byte accounting uses caller-supplied per-adapter sizes (the bank
   computes them from factor-leaf metadata — no device fetch).
 """
@@ -51,6 +57,10 @@ class AdapterRegistry:
         self._ids: dict[str, int] = {}
         self._nbytes: dict[str, int] = {}
         self._free = list(range(1, self.n_adapters))
+        # per-row tenant-incarnation counter: bumped every time a row is
+        # (re)assigned, so (aid, generation) identifies one tenant's
+        # factors forever even though rows recycle
+        self._gen = [0] * self.n_adapters
         self.used_bytes = 0
         self.n_registered_total = 0
         self.n_evicted = 0
@@ -76,6 +86,7 @@ class AdapterRegistry:
         aid = self._free.pop(0)
         self._ids[name] = aid
         self._nbytes[name] = int(nbytes)
+        self._gen[aid] += 1  # new tenant incarnation of this row
         self.used_bytes += int(nbytes)
         self.n_registered_total += 1
         return aid
@@ -106,6 +117,15 @@ class AdapterRegistry:
         """Is ``aid`` servable? Row 0 always; others only while registered
         (the engine's ``Request.adapter`` admission check)."""
         return aid == 0 or aid in self._ids.values()
+
+    def generation(self, aid: int) -> int:
+        """Current tenant incarnation of row ``aid`` (0 for the base row
+        and for never-assigned rows). The engine captures this at submit
+        and re-checks it at refill: a mismatch means the row was handed
+        to a DIFFERENT tenant (or the same name re-registered with new
+        factors) while the request sat in the queue — serving it anyway
+        would decode under the wrong weights."""
+        return self._gen[aid]
 
     def stats(self) -> dict:
         return {

@@ -58,9 +58,16 @@ Orca-style (OSDI '22) fix, built TPU-native:
   :func:`..adapters.bank.apply_lora` inside the same compiled programs,
   so tenants with different adapters co-batch with zero recompiles and
   id 0 (zero factors) is EXACTLY the base model. ``Request.adapter`` is
-  validated at :meth:`submit` (admission, like the window check); prefix
-  keys are namespaced per adapter so tenants never splice each other's
-  KV. Bank off keeps the state tree and compiled programs
+  validated at :meth:`submit` (admission, like the window check), which
+  also snapshots the row's tenant-generation — bank rows recycle, so a
+  request whose tenant is evicted (or whose row is re-registered) while
+  it queues completes with ``finish_reason == "adapter_evicted"``
+  instead of decoding under the wrong factors. Prefix keys are
+  namespaced per (adapter, generation) so tenants never splice each
+  other's KV — not even a later tenant reusing an evicted tenant's row.
+  ``register``/``evict`` on a live engine take effect at the next
+  :meth:`step` (the engine re-merges automatically when the bank's
+  version moves). Bank off keeps the state tree and compiled programs
   byte-identical.
 
 Greedy decoding is token-exact vs one-shot ``generate()`` (same math,
@@ -180,6 +187,9 @@ class ServeEngine:
             self._base_params = params
             model = adapter_bank.model
             params = adapter_bank.merge_params(params)
+            # bank version this merge reflects; step() re-merges when
+            # the bank moves past it (register/evict on a live engine)
+            self._merged_version = adapter_bank.version
         self.model = model
         self.params = params
         self.n_slots = n_slots
@@ -234,8 +244,11 @@ class ServeEngine:
         self.n_verify_forwards = 0
         self.spec_steps_consumed = 0
         self.spec_drafts_accepted = 0
-        # requests served with a non-base adapter (receipt counter)
+        # requests served with a non-base adapter, and requests bounced
+        # at refill because their tenant was evicted / their row
+        # re-registered while queued (receipt counters)
         self.adapter_requests = 0
+        self.adapter_rejected = 0
         # donating the state tree lets XLA update the multi-hundred-MB
         # cache in place; CPU jit warns on donation (unsupported), so
         # only donate where it is real
@@ -531,7 +544,13 @@ class ServeEngine:
         capacity (backpressure) or ``ValueError`` when the request can
         never fit the window — or names an adapter this engine cannot
         serve (no bank, or an unregistered/out-of-range id): admission
-        failures are always synchronous, never a mid-decode surprise."""
+        failures are always synchronous, never a mid-decode surprise.
+
+        Admission also snapshots the adapter row's tenant-generation
+        (rows recycle): :meth:`_refill` re-checks it, so a request whose
+        tenant is evicted — or whose row is handed to a NEW tenant —
+        while it queues completes as ``"adapter_evicted"`` instead of
+        silently decoding under someone else's factors."""
         aid = int(getattr(request, "adapter", 0))
         if aid != 0 and not self._adapters:
             raise ValueError(
@@ -540,6 +559,7 @@ class ServeEngine:
             )
         if self._adapters:
             self._bank.check_id(aid)
+            request.adapter_gen = self._bank.generation(aid)
         return self.scheduler.submit(request)
 
     @property
@@ -557,6 +577,13 @@ class ServeEngine:
         round (possibly mid-chain — surplus chain tokens for a finished
         slot are discarded, exactly like ``generate()`` truncating at
         ``max_new_tokens``)."""
+        if self._adapters and self._bank.version != self._merged_version:
+            # register/evict moved the bank since the last merge: pick
+            # the new factors up BEFORE refilling, so freshly admitted
+            # tenants never decode against a stale merge (in-flight
+            # slots see the new factors too — register into a free row
+            # before serving it and this is a non-event for them)
+            self.refresh_adapters()
         done: list[Completion] = []
         for s in range(self.n_slots):
             if self._slots[s] is not None:
@@ -602,12 +629,32 @@ class ServeEngine:
         so eviction only ever happens here, BETWEEN decode chains, and
         never under a slot mid-decode.
 
-        Prefix keys are NAMESPACED by the request's adapter id
-        (:meth:`_prefix_key`): a tenant's K/V depends on its factors, so
-        a cross-tenant splice would seed a slot with wrong-adapter
-        prefixes — disjoint key ranges make that lookup structurally
-        impossible while keeping the index itself adapter-oblivious."""
+        Prefix keys are NAMESPACED by the request's (adapter id,
+        tenant-generation) pair (:meth:`_prefix_key`): a tenant's K/V
+        depends on its factors, so a cross-tenant splice would seed a
+        slot with wrong-adapter prefixes — disjoint key ranges make that
+        lookup structurally impossible while keeping the index itself
+        adapter-oblivious, and the generation keeps it impossible when a
+        later tenant recycles an evicted tenant's row.
+
+        The same staleness check guards the request itself: if its
+        tenant was evicted (or the row re-registered) since submit, the
+        request completes here as ``"adapter_evicted"`` — zero device
+        work, zero fetches — rather than decode under zeroed or, worse,
+        another tenant's factors."""
         aid = int(getattr(req, "adapter", 0))
+        if aid and not (
+            self._bank.registry.is_live(aid)
+            and self._bank.generation(aid) == req.adapter_gen
+        ):
+            self.adapter_rejected += 1
+            return [Completion(
+                request_id=req.request_id,
+                prompt=[int(t) for t in req.prompt],
+                tokens=[],
+                finish_reason="adapter_evicted",
+                latency_s=time.perf_counter() - req.submitted_s,
+            )]
         if aid:
             self.adapter_requests += 1
         prompt = [int(t) for t in req.prompt]
@@ -674,16 +721,23 @@ class ServeEngine:
         return []
 
     def _prefix_key(self, prompt: list[int], aid: int) -> list[int]:
-        """Adapter-scoped prefix-index key: shift every token by
-        ``aid * vocab_size`` so tenants occupy disjoint key ranges —
-        same LPM depth within a tenant, zero matches across tenants.
-        Host-only arithmetic (the index never sees real token ids for
-        aid > 0, which is fine: keys are opaque to it); aid 0 keys are
-        the raw prompt, so base-model streams share the index exactly as
-        before the bank existed."""
+        """Tenant-scoped prefix-index key: shift every token by
+        ``(generation * n_adapters + aid) * vocab_size`` so each tenant
+        INCARNATION occupies a disjoint key range — same LPM depth
+        within a tenant, zero matches across tenants. The generation
+        matters because rows recycle: evict A, register B, and B lands
+        on A's row — a bare-aid namespace would hand B LPM hits whose
+        segments hold KV computed with A's factors. Segments keyed under
+        a dead generation simply stop being reachable and age out of the
+        byte budget via LRU. Host-only arithmetic (the index never sees
+        real token ids for aid > 0, which is fine: keys are opaque to
+        it); aid 0 keys are the raw prompt (row 0 is never reassigned,
+        its generation is pinned 0), so base-model streams share the
+        index exactly as before the bank existed."""
         if aid == 0:
             return prompt
-        shift = aid * int(self.model.cfg.vocab_size)
+        ns = self._bank.generation(aid) * self._bank.n_adapters + aid
+        shift = ns * int(self.model.cfg.vocab_size)
         return [t + shift for t in prompt]
 
     def _distribute(self, toks) -> list[Completion]:
@@ -813,13 +867,17 @@ class ServeEngine:
         :meth:`..adapters.bank.AdapterBank.register` / ``evict`` on a
         LIVE engine. The factor arrays are functionally updated, so the
         engine's merged tree must be rebuilt — shapes are unchanged, so
-        nothing recompiles. Call it between :meth:`step` rounds; requests
-        already decoding keep their slot's id but see the new factors
-        (register into a FREE row before serving it and this is a
-        non-event for in-flight traffic)."""
+        nothing recompiles. :meth:`step` calls this AUTOMATICALLY when
+        the bank's version moved past the engine's last merge, so a
+        plain register -> submit -> step sequence serves the new factors
+        with no extra call; invoke it directly only to take the re-merge
+        eagerly. Requests already decoding keep their slot's id but see
+        the new factors (register into a FREE row before serving it and
+        this is a non-event for in-flight traffic)."""
         if not self._adapters:
             raise ValueError("engine has no adapter bank")
         self.params = self._bank.merge_params(self._base_params)
+        self._merged_version = self._bank.version
 
     def adapter_stats(self) -> dict[str, int | float]:
         """Multi-tenancy counters for the serving receipt (same pattern
@@ -835,6 +893,7 @@ class ServeEngine:
             "lora_rank": self._bank.rank,
             "adapters_registered": len(reg),
             "adapter_requests": self.adapter_requests,
+            "adapter_rejected": self.adapter_rejected,
             "adapter_bytes": reg.used_bytes,
         }
 

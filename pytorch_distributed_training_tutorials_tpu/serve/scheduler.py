@@ -42,7 +42,11 @@ class Request:
     a synchronous ``ValueError``, the same admission contract as the
     window check — then carried as DATA through prefill/splice/refill
     and the decode chain, so tenants with different adapters co-batch in
-    one compiled program.
+    one compiled program. Bank rows recycle, so submit also snapshots
+    the row's tenant-generation (``adapter_gen``); if the tenant is
+    evicted — or the row re-registered — while the request queues, the
+    engine completes it with ``finish_reason == "adapter_evicted"``
+    rather than decode under the wrong factors.
     """
 
     prompt: Any
@@ -53,6 +57,7 @@ class Request:
     # engine-assigned bookkeeping (not caller inputs)
     request_id: int = -1
     submitted_s: float = 0.0
+    adapter_gen: int = 0
 
 
 @dataclasses.dataclass
@@ -61,12 +66,14 @@ class Completion:
     excluded, stop token included when ``finish_reason == "eos"``);
     ``latency_s`` is submit-to-completion wall time and ``ttft_s``
     submit-to-first-token (the prefill/splice fetch) — the pair the
-    serving receipt reports as p50/p95."""
+    serving receipt reports as p50/p95. ``"adapter_evicted"`` means the
+    request's tenant was evicted (or its bank row re-registered) while
+    it queued: zero tokens were generated — resubmit under a live id."""
 
     request_id: int
     prompt: list[int]
     tokens: list[int]
-    finish_reason: str  # "length" | "eos"
+    finish_reason: str  # "length" | "eos" | "adapter_evicted"
     latency_s: float
     ttft_s: float = 0.0
 

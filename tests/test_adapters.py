@@ -133,6 +133,41 @@ def test_registry_needs_a_tenant_row():
         AdapterRegistry(1)
 
 
+def test_registry_generation_tracks_row_reuse():
+    """Rows recycle, so a bare id is ambiguous across evict/register
+    cycles: every (re)assignment bumps the row's generation, and row 0
+    (base, never reassigned) stays pinned at 0 — the token the engine
+    folds into prefix keys and queued-request admission."""
+    reg = AdapterRegistry(3)
+    assert reg.generation(0) == 0 and reg.generation(1) == 0
+    reg.register("a")
+    assert reg.generation(1) == 1
+    reg.evict("a")  # eviction alone frees the row; the incarnation
+    assert reg.generation(1) == 1  # changes only when someone takes it
+    reg.register("b")  # recycles row 1
+    assert reg.lookup("b") == 1 and reg.generation(1) == 2
+    assert reg.generation(0) == 0 and reg.generation(2) == 0
+
+
+def test_bank_version_moves_with_the_factors():
+    """``AdapterBank.version`` bumps exactly when the factor tree
+    changes (register/evict) — the signal a live engine uses to re-merge
+    at its next step(). A rolled-back register leaves it untouched."""
+    model, _ = _make()
+    bank = AdapterBank(model, n_adapters=3, rank=4)
+    assert bank.version == 0
+    bank.register("t", _filled_row(bank, 5))
+    assert bank.version == 1 and bank.generation(1) == 1
+    bad = jax.tree_util.tree_map(
+        lambda leaf: leaf[..., :-1], bank.row_zeros()
+    )
+    with pytest.raises(ValueError, match="factor shape"):
+        bank.register("u", bad)
+    assert bank.version == 1  # rollback: factors never changed
+    bank.evict("t")
+    assert bank.version == 2
+
+
 # -------------------------------------------------------------- apply_lora
 
 def test_apply_lora_matches_per_row_dense():

@@ -998,9 +998,12 @@ def test_adapter_spec_and_splice_composed(model_params):
 
 
 def test_adapter_refresh_picks_up_registrations(model_params):
-    """register/evict after engine construction are invisible until
-    ``refresh_adapters()`` re-merges — then the new tenant's delta is
-    live, matching an engine built fresh over the same bank."""
+    """register/evict after engine construction are live at the NEXT
+    ``step()``: the engine notices the bank's version moved and
+    re-merges automatically (no ``refresh_adapters()`` call needed —
+    before this, submit admitted the new id while serving silently ran
+    the stale zero-factor merge), matching an engine built fresh over
+    the same bank. The eager path stays available and idempotent."""
     model, params = model_params
     bank = _lora_bank(model, tenants=(1,))
     engine = ServeEngine(
@@ -1024,16 +1027,93 @@ def test_adapter_refresh_picks_up_registrations(model_params):
         ),
         bank.row_zeros(),
     ))
-    assert run(engine, 2) == base  # stale merge: still the zero row
-    engine.refresh_adapters()
     fresh = ServeEngine(
         model, params, n_slots=1, tokens_per_launch=8, adapter_bank=bank
     )
-    got = run(engine, 2)
+    got = run(engine, 2)  # no refresh_adapters(): step() re-merged
     assert got == run(fresh, 2) and got != base
+    engine.refresh_adapters()  # eager path: idempotent no-op here
+    assert run(engine, 2) == got
     plain = ServeEngine(model, params, n_slots=1)
     with pytest.raises(ValueError):
         plain.refresh_adapters()
+
+
+def test_adapter_row_reuse_never_splices_stale_kv(model_params):
+    """The row-recycling hazard: evict A, register B — the lowest-free
+    policy hands B the exact row A held, but A's prefix segments were
+    computed with A's factors. Generation-scoped prefix keys make B's
+    lookups miss them structurally (and B's own re-runs still hit)."""
+    import numpy as np
+
+    model, params = model_params
+    bank = _lora_bank(model, tenants=(1,))
+    engine = ServeEngine(
+        model, params, n_slots=1, tokens_per_launch=8, adapter_bank=bank,
+        prefix_cache_bytes=16 * 1024 * 1024,
+    )
+    prompt = _prompt(2600, 12)
+
+    def run(aid):
+        rid = engine.submit(
+            Request(prompt=prompt, max_new_tokens=6, adapter=aid)
+        )
+        return {c.request_id: c for c in engine.run_until_idle()}[rid].tokens
+
+    t_a = run(1)
+    assert run(1) == t_a and engine.n_splices == 1  # A's cache is hot
+    bank.evict("tenant-1")
+    rng = np.random.Generator(np.random.PCG64(555))
+    row = bank.register("tenant-B", jax.tree_util.tree_map(
+        lambda leaf: jnp.asarray(
+            rng.standard_normal(leaf.shape) * 0.05, leaf.dtype
+        ),
+        bank.row_zeros(),
+    ))
+    assert row == 1  # B really did recycle A's row
+    t_b = run(1)
+    # B's first run must NOT splice from A's stale segments...
+    assert engine.n_splices == 1
+    assert t_b != t_a  # ...and B's factors are live, not A's
+    # ...while B's own segments are reachable on the re-run
+    assert run(1) == t_b and engine.n_splices == 2
+
+
+def test_adapter_evicted_while_queued(model_params):
+    """A request admitted under a live tenant whose row is evicted (or
+    recycled to a new tenant) before refill completes as
+    ``adapter_evicted`` — zero tokens, zero device work — never decoding
+    under zeroed or another tenant's factors."""
+    import numpy as np
+
+    model, params = model_params
+    bank = _lora_bank(model, tenants=(1,))
+    engine = ServeEngine(
+        model, params, n_slots=1, tokens_per_launch=8, adapter_bank=bank
+    )
+    rid = engine.submit(
+        Request(prompt=_prompt(2700, 5), max_new_tokens=6, adapter=1)
+    )
+    bank.evict("tenant-1")
+    rng = np.random.Generator(np.random.PCG64(556))
+    bank.register("usurper", jax.tree_util.tree_map(  # recycles row 1
+        lambda leaf: jnp.asarray(
+            rng.standard_normal(leaf.shape) * 0.05, leaf.dtype
+        ),
+        bank.row_zeros(),
+    ))
+    (done,) = engine.run_until_idle()
+    assert done.request_id == rid
+    assert done.finish_reason == "adapter_evicted" and done.tokens == []
+    assert engine.n_prefills == 0 and engine.n_chains == 0
+    assert engine.adapter_stats()["adapter_rejected"] == 1
+    # a fresh submit under the recycled row is the NEW tenant's traffic
+    rid2 = engine.submit(
+        Request(prompt=_prompt(2700, 5), max_new_tokens=6, adapter=1)
+    )
+    (done2,) = engine.run_until_idle()
+    assert done2.request_id == rid2 and done2.finish_reason != "adapter_evicted"
+    assert len(done2.tokens) == 6
 
 
 # ------------------------------------------------------------- the selftest
