@@ -279,6 +279,15 @@ def main():
         help="LoRA rank of the adapter bank rows (--adapters)",
     )
     ap.add_argument(
+        "--deadline-s", type=float, default=None, dest="deadline_s",
+        help="for --server: per-request deadline in seconds "
+        "(serve.ServeEngine default_deadline_s; None disables). Expired "
+        "requests complete finish_reason='deadline' at the next chain "
+        "boundary keeping the tokens they earned — the receipt gains "
+        "fault_stats() counters (deadline_expired, cancelled, "
+        "nonfinite_quarantined)",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -608,6 +617,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         speculative_k=args.spec_k,
         spec_ngram=args.spec_ngram,
         adapter_bank=bank,
+        default_deadline_s=args.deadline_s,
     )
     rng = np.random.Generator(np.random.PCG64(11))
     # one shared token family: request i's prompt = shared[:k] + tail,
@@ -616,12 +626,13 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     # plain random stream at 0.0
     shared = rng.integers(0, cfg.vocab_size, (max(lengths),)).tolist()
 
-    def mk_request(i: int) -> Request:
+    def mk_request(i: int, deadline_s: float | None = None) -> Request:
         p_len = lengths[i % len(lengths)]
         k = min(p_len, int(round(args.prefix_overlap * p_len)))
         tail = rng.integers(0, cfg.vocab_size, (p_len - k,)).tolist()
         return Request(
             prompt=shared[:k] + tail, max_new_tokens=new, seed=i,
+            deadline_s=deadline_s,
             # cycle every bank row (0 = base) through the shared slots
             adapter=(i % args.adapters) if bank is not None else 0,
         )
@@ -633,7 +644,11 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     # shared family resident, so the timed stream is steady-state.
     t0 = time.perf_counter()
     for i in range(len(lengths)):
-        engine.submit(mk_request(i))
+        # warmup is COMPILE time (minutes at 1B) — exempt it from any
+        # --deadline-s so the timed stream starts with live programs
+        engine.submit(mk_request(
+            i, deadline_s=1e9 if args.deadline_s is not None else None,
+        ))
     engine.run_until_idle()
     compile_s = time.perf_counter() - t0
     engine.n_chains = engine.n_prefills = engine.generated_tokens = 0
@@ -641,6 +656,8 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     engine.n_verify_forwards = engine.spec_steps_consumed = 0
     engine.spec_drafts_accepted = 0
     engine.adapter_requests = 0
+    engine.n_deadline_expired = engine.n_cancelled = 0
+    engine.nonfinite_quarantined = engine.n_prefill_errors = 0
     if engine.prefix is not None:
         engine.prefix.hits = engine.prefix.misses = 0
 
@@ -681,6 +698,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         **engine.prefix_stats(),
         **engine.spec_stats(),
         **engine.adapter_stats(),
+        **engine.fault_stats(),
         backend=jax.default_backend(),
     )
     prefix_note = ""
@@ -704,6 +722,12 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
             f", adapters: {ast['adapters_registered']}/"
             f"{ast['n_adapters'] - 1} tenants (rank {ast['lora_rank']}), "
             f"{ast['adapter_requests']} tenant requests"
+        )
+    if args.deadline_s is not None:
+        fst = engine.fault_stats()
+        prefix_note += (
+            f", deadline {args.deadline_s}s: "
+            f"{fst['deadline_expired']} expired"
         )
     print(
         f"server: {args.requests} requests (prompts {lengths}, {new} new "

@@ -72,6 +72,13 @@ CONFIG_FIELDS = (
     # (adapters_registered, adapter_requests) stay out — workload
     # outcomes, not configuration
     "n_adapters", "lora_rank", "adapters",
+    # robustness layer (ISSUE 9): fault injection / deadlines / the
+    # finite-logits guard change what a round measures, so chaos rounds
+    # never gate — or get gated by — clean rounds. The fault COUNTERS
+    # (deadline_expired, cancelled, nonfinite_quarantined, steps_skipped)
+    # stay out deliberately: they are outcomes of the traffic, not
+    # configuration of the experiment
+    "chaos", "deadline_s", "guard_nonfinite",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

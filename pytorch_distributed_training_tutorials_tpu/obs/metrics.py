@@ -59,7 +59,7 @@ class MetricsLogger:
         self.events: collections.deque[dict] = collections.deque(
             maxlen=capacity
         )
-        self._pending: collections.deque[tuple[int, object]] = (
+        self._pending: collections.deque[tuple[int, object, dict | None]] = (
             collections.deque(maxlen=capacity)
         )
         self.jsonl_path = jsonl_path
@@ -83,18 +83,23 @@ class MetricsLogger:
 
     # -- event intake ------------------------------------------------------
 
-    def log_step(self, step: int, loss, verbose: bool = False) -> None:
+    def log_step(
+        self, step: int, loss, verbose: bool = False, extra: dict | None = None
+    ) -> None:
         """Record a step's loss. NO host sync unless ``verbose``.
 
         ``loss`` may be a device scalar — it is retained un-fetched. A
         verbose call (the Trainer's ``log_every`` opt-in) fetches ONCE and
         prints + records the same float, the one deliberate per-step sync
-        this module permits.
+        this module permits. ``extra`` is an optional dict of additional
+        scalars (device or host — e.g. the skip-step counter ISSUE 9's
+        guardrails emit); its values ride the SAME batched drain fetch as
+        the loss, so extras never add a host sync either.
         """
         if verbose:
             loss = float(loss)  # the single opted-in fetch
             self.say(f"  step {step}: loss {loss:.4f}")
-        self._pending.append((int(step), loss))
+        self._pending.append((int(step), loss, extra))
 
     def log_epoch(self, metrics: dict) -> dict:
         """Record an epoch event (and drain pending steps, fetch rules
@@ -131,9 +136,14 @@ class MetricsLogger:
         pending = list(self._pending)
         self._pending.clear()
         # ONE batched fetch for everything accumulated since the last drain
-        values = jax.device_get([v for _, v in pending])
-        for (step, _), val in zip(pending, values):
-            self._record({"kind": "step", "step": step, "loss": float(val)})
+        # (device_get walks the pytree, so loss + extras fetch together;
+        # None extras are empty subtrees).
+        values = jax.device_get([(v, e) for _, v, e in pending])
+        for (step, _, _), (val, ext) in zip(pending, values):
+            event = {"kind": "step", "step": step, "loss": float(val)}
+            if ext:
+                event.update({k: float(v) for k, v in ext.items()})
+            self._record(event)
 
     def flush(self) -> None:
         """Drain pending device scalars (even under defer_host_fetch — this

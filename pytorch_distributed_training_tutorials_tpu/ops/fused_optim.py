@@ -15,7 +15,14 @@ off-TPU, the house pattern), with the moment buffers aliased in-place
 ``optax.adamw``'s exact update math (``scale_by_adam`` with bias-corrected
 moments, decoupled weight decay, ``-lr`` scaling): 100-step trajectory
 equivalence is pinned by ``tests/test_fused_optim.py``. The Trainer's
-``_apply_update`` consumes it unchanged.
+``_apply_update`` consumes it unchanged — including the ISSUE 9 skip-step
+guard (``Trainer(skip_nonfinite=True)``): the guard's ``jnp.where``
+select runs AFTER ``tx.update`` on the update's outputs, so even with the
+moment buffers aliased in-place here, a skipped step keeps params,
+``mu``/``nu``, and ``count`` bitwise unchanged (XLA copies a donated
+buffer whose pre-update value is still live in the select;
+``tests/test_trainer.py::test_skip_step_through_grad_accum_and_fused_adamw``
+pins it).
 """
 
 from __future__ import annotations

@@ -7,8 +7,10 @@ Public surface:
   run_until_idle);
 - :class:`.scheduler.Request` / :class:`.scheduler.Completion` — the
   request/response records;
-- :class:`.scheduler.FifoScheduler` / :class:`.scheduler.QueueFull` —
-  the host-side queue and its backpressure signal;
+- :class:`.scheduler.FifoScheduler` / :class:`.scheduler.QueueFull` /
+  :class:`.scheduler.QueueClosed` — the host-side queue and its
+  backpressure/shutdown signals (``ServeEngine.close``/``drain`` stop
+  admission and run accepted work to completion);
 - :func:`.slots.bucket_len` / :func:`.slots.init_slot_state` /
   :func:`.slots.write_slot` — the slot-state building blocks (exposed
   for tests and for engines over non-TransformerLM models);
@@ -37,6 +39,7 @@ _LAZY_EXPORTS = {
     "Segment": "pytorch_distributed_training_tutorials_tpu.serve.prefix",
     "Completion": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "FifoScheduler": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
+    "QueueClosed": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "QueueFull": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "Request": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "bucket_len": "pytorch_distributed_training_tutorials_tpu.serve.slots",

@@ -28,8 +28,16 @@ mixed-tenant stream: every request's greedy tokens must be byte-identical
 to a DEDICATED single-tenant engine over the same bank, id-0 requests
 byte-identical to the bank-less base engine, the fetch budget unchanged,
 and admission of an unregistered id must fail synchronously at submit.
-Prints exactly one JSON line (a ``graft-receipt/v1`` envelope) and
-exits non-zero on any failure.
+A fifth (``--chaos``) arm runs the ISSUE 9 fault-injection gauntlet:
+one guarded engine takes an injected NaN-logit (the poisoned request
+must finish ``"nonfinite"`` with its clean prefix of tokens while the
+co-scheduled request stays byte-identical to a fault-free run), a
+deadline expiry, a host-side cancel and a close/drain — fetch budget
+still counted — and a mini training leg drives the skip-step guard
+(poisoned batch leaves TrainState bitwise unchanged, the skip counter
+increments once). The receipt gains the ``fault_stats()`` fields plus
+``steps_skipped``. Prints exactly one JSON line (a ``graft-receipt/v1``
+envelope) and exits non-zero on any failure.
 """
 
 from __future__ import annotations
@@ -41,7 +49,7 @@ import sys
 
 
 def selftest(json_path: str | None = None, spec_k: int = 2,
-             adapters: int = 3) -> dict:
+             adapters: int = 3, chaos: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -411,6 +419,161 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
     if astats.get("adapter_requests", 0) < 1:
         problems.append(f"no tenant traffic recorded: {astats}")
 
+    # ------------------------------------------------------------------
+    # chaos arm (--chaos, ISSUE 9): one staggered stream exercising every
+    # serving failure path — injected NaN logits (quarantine), a deadline
+    # expiry, a host-side cancel, close/drain — with the fetch budget
+    # still counted, co-scheduled requests still token-identical to a
+    # clean run; plus a mini training leg driving the skip-step guard
+    # (poisoned batch -> state bitwise unchanged, counter increments)
+    # ------------------------------------------------------------------
+    fault_fields: dict = {}
+    if chaos:
+        import optax
+
+        from pytorch_distributed_training_tutorials_tpu.models import (
+            LinearRegressor,
+        )
+        from pytorch_distributed_training_tutorials_tpu.serve import (
+            QueueClosed,
+        )
+        from pytorch_distributed_training_tutorials_tpu.train.trainer import (
+            TrainState,
+            make_train_step,
+        )
+        from pytorch_distributed_training_tutorials_tpu.utils import (
+            chaos as chaos_lib,
+        )
+
+        p0, p1 = prompts[0][0], prompts[1][0]
+        ccfg = chaos_lib.ChaosConfig(nan_logit_slot=0, nan_logit_step=3)
+
+        # clean reference (guard on, NO faults) for token-identity
+        eng_ref = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=4,
+            guard_nonfinite=True,
+        )
+        eng_ref.submit(Request(prompt=p0, max_new_tokens=12))
+        eng_ref.submit(Request(prompt=p1, max_new_tokens=16))
+        ref = {c.request_id: c for c in eng_ref.run_until_idle()}
+
+        eng_x = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=4,
+            guard_nonfinite=True, chaos=ccfg,
+        )
+        count = {"n": 0}
+
+        def counting(x):
+            count["n"] += 1
+            return real_get(x)
+
+        jax.device_get = counting
+        try:
+            r0 = eng_x.submit(Request(prompt=p0, max_new_tokens=12))
+            r1 = eng_x.submit(Request(prompt=p1, max_new_tokens=16))
+            r2 = eng_x.submit(
+                Request(prompt=p0, max_new_tokens=8, deadline_s=1e-6)
+            )
+            r3 = eng_x.submit(Request(prompt=p1, max_new_tokens=8))
+            eng_x.cancel(r3)
+            out = {c.request_id: c for c in eng_x.drain()}
+        finally:
+            jax.device_get = real_get
+        chaos_fetches = count["n"]
+        try:
+            eng_x.submit(Request(prompt=p0, max_new_tokens=2))
+            problems.append("submit admitted after close()")
+        except QueueClosed:
+            pass
+        if out[r0].finish_reason != "nonfinite":
+            problems.append(
+                f"poisoned slot finished {out[r0].finish_reason!r}, "
+                "expected 'nonfinite'"
+            )
+        chaos_exact = (
+            out[r0].tokens == ref[0].tokens[: len(out[r0].tokens)]
+            and len(out[r0].tokens) < len(ref[0].tokens)
+            and out[r1].tokens == ref[1].tokens
+        )
+        if not chaos_exact:
+            problems.append(
+                f"chaos arm tokens diverged from clean run: poisoned "
+                f"{out[r0].tokens} vs clean {ref[0].tokens}, co-scheduled "
+                f"{out[r1].tokens} vs {ref[1].tokens}"
+            )
+        if out[r2].finish_reason != "deadline" or out[r2].tokens:
+            problems.append(
+                f"deadline request finished {out[r2].finish_reason!r} "
+                f"with {len(out[r2].tokens)} tokens"
+            )
+        if out[r3].finish_reason != "cancelled":
+            problems.append(
+                f"cancelled request finished {out[r3].finish_reason!r}"
+            )
+        chaos_budget = eng_x.n_chains + eng_x.n_prefills + eng_x.n_splices
+        if chaos_fetches > chaos_budget:
+            problems.append(
+                f"chaos arm: {chaos_fetches} host fetches > "
+                f"{chaos_budget} (chains + prefills + splices)"
+            )
+        fstats = eng_x.fault_stats()
+        for key, want in (
+            ("nonfinite_quarantined", 1),
+            ("deadline_expired", 1),
+            ("cancelled", 1),
+        ):
+            if fstats.get(key) != want:
+                problems.append(
+                    f"fault_stats[{key!r}] = {fstats.get(key)}, "
+                    f"expected {want}"
+                )
+
+        # mini training leg: skip-step guard on a poisoned batch
+        reg = LinearRegressor(in_dim=4)
+        key = jax.random.PRNGKey(2)
+        xb = jax.random.normal(key, (8, 4))
+        yb = jnp.ones((8, 1), jnp.float32)
+        st = TrainState.create(
+            apply_fn=reg.apply,
+            params=reg.init(key, xb)["params"],
+            tx=optax.adam(1e-2),
+        )
+        gstep = make_train_step(loss="mse", skip_nonfinite=True)
+        tcfg = chaos_lib.ChaosConfig(nan_batch_step=1)
+        before = real_get((st.params, st.opt_state, st.step))
+        st1, m1 = gstep(st, chaos_lib.maybe_poison_batch(tcfg, 1, (xb, yb)))
+        after = real_get((st1.params, st1.opt_state, st1.step))
+        st2, m2 = gstep(st1, chaos_lib.maybe_poison_batch(tcfg, 2, (xb, yb)))
+        steps_skipped = int(real_get(m1["skipped"])) + int(
+            real_get(m2["skipped"])
+        )
+        import numpy as np
+
+        bitwise_skip = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(before),
+                jax.tree_util.tree_leaves(after),
+            )
+        )
+        if not bitwise_skip:
+            problems.append(
+                "skip-step left TrainState changed after a poisoned batch"
+            )
+        if steps_skipped != 1:
+            problems.append(
+                f"steps_skipped = {steps_skipped}, expected exactly 1 "
+                "(poisoned batch skipped, clean batch applied)"
+            )
+        if int(real_get(st2.step)) != 1:
+            problems.append("clean step after the skip did not apply")
+        fault_fields = {
+            **fstats,
+            "steps_skipped": steps_skipped,
+            "chaos_token_exact": chaos_exact,
+            "chaos_host_fetches": chaos_fetches,
+        }
+
     receipt = make_receipt(
         "serve_selftest",
         {
@@ -437,6 +600,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             "adapter_token_exact": adapter_exact,
             "adapter_host_fetches": fetches_mix,
             **astats,
+            **fault_fields,
             "problems": problems,
             "ok": not problems,
         },
@@ -469,6 +633,12 @@ def main(argv: list[str] | None = None) -> int:
         help="bank rows for the multi-tenant selftest arm (>= 2; "
         "rows 1..N-1 become tenants, row 0 is the base model)",
     )
+    parser.add_argument(
+        "--chaos", action="store_true",
+        help="also run the fault-injection arm: NaN-logit quarantine, "
+        "deadline expiry, cancel, close/drain, and the training "
+        "skip-step guard (ISSUE 9)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -488,7 +658,7 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
     receipt = selftest(args.json, spec_k=args.spec_k,
-                       adapters=args.adapters)
+                       adapters=args.adapters, chaos=args.chaos)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

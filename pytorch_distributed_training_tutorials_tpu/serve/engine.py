@@ -70,6 +70,34 @@ Orca-style (OSDI '22) fix, built TPU-native:
   version moves). Bank off keeps the state tree and compiled programs
   byte-identical.
 
+Failure handling (ISSUE 9) lives at the SAME boundaries the scheduler
+does — between chains and at refill, never inside a compiled program:
+
+- deadlines (``Request.deadline_s`` / engine ``default_deadline_s``)
+  and host-side :meth:`cancel` complete a request ``"deadline"`` /
+  ``"cancelled"`` at the next chain/refill boundary via the existing
+  park path (partial tokens kept; a queued victim completes with zero
+  device work, like ``"adapter_evicted"``);
+- :meth:`close` stops admission (``QueueClosed`` backpressure) and
+  :meth:`drain` runs every accepted request to completion — graceful
+  shutdown without dropping in-flight work;
+- with ``guard_nonfinite=True`` the chain also emits a per-slot
+  finite-logits flag per step, riding the SAME batched fetch (budget
+  unchanged): a request that drives logits to NaN/Inf completes
+  ``"nonfinite"`` with its pre-poison tokens, its slot parks and is
+  rewritten whole by the next refill (quarantine), and co-scheduled
+  slots — independent across the batch dim — keep decoding
+  token-identically to a clean run;
+- a prefill that RAISES (hardware fault, injected chaos) is isolated to
+  its request (``"error"``, slot parked, engine keeps serving);
+- a :class:`..utils.chaos.ChaosConfig` injects deterministic faults
+  (NaN logits at (slot, step), prefill failure, launch stall) so every
+  path above is exercised by tests, not just reasoned about.
+
+Guard/deadline/chaos OFF keeps the state tree and compiled programs
+byte-identical to the pre-robustness engine (the same Python-default
+trick the prefix cache, speculation, and adapter bank use).
+
 Greedy decoding is token-exact vs one-shot ``generate()`` (same math,
 same cache semantics; pinned by tests/test_serve.py). Temperature /
 top-k / top-p are ENGINE-level statics — per-request sampling params
@@ -108,6 +136,7 @@ from pytorch_distributed_training_tutorials_tpu.serve.slots import (
     tree_nbytes,
     write_slot,
 )
+from pytorch_distributed_training_tutorials_tpu.utils import chaos as chaos_lib
 
 
 class _Active:
@@ -159,6 +188,9 @@ class ServeEngine:
         speculative_k: int = 0,
         spec_ngram: int = 3,
         adapter_bank=None,
+        default_deadline_s: float | None = None,
+        guard_nonfinite: bool = False,
+        chaos=None,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -166,6 +198,10 @@ class ServeEngine:
             raise ValueError("tokens_per_launch must be >= 1")
         if speculative_k < 0:
             raise ValueError("speculative_k must be >= 0")
+        if default_deadline_s is not None and default_deadline_s <= 0:
+            raise ValueError(
+                "default_deadline_s must be > 0 (None = no deadline)"
+            )
         # adapter bank: None = off (the engine then builds byte-identical
         # state and compiled programs to the adapter-free one). On, the
         # engine serves the bank's LoRA twin of the caller's model over
@@ -249,15 +285,38 @@ class ServeEngine:
         # re-registered while queued (receipt counters)
         self.adapter_requests = 0
         self.adapter_rejected = 0
+        # robustness layer (ISSUE 9): deadlines/cancel/drain are pure
+        # host bookkeeping (no compiled-program impact at all); the
+        # non-finite guard changes only the chain's OUTPUT (the flag
+        # rides the existing batched fetch), never the state tree.
+        self._deadline = default_deadline_s
+        self._guard = bool(guard_nonfinite)
+        self._chaos = chaos
+        self._inject_logits = chaos is not None and chaos.poisons_logits
+        self._cancelled: set[int] = set()
+        self.n_deadline_expired = 0
+        self.n_cancelled = 0
+        self.nonfinite_quarantined = 0
+        self.n_prefill_errors = 0
         # donating the state tree lets XLA update the multi-hundred-MB
         # cache in place; CPU jit warns on donation (unsupported), so
         # only donate where it is real
         donate = (1,) if jax.default_backend() in ("tpu", "gpu") else ()
         self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
-        self._chain = jax.jit(
-            self._spec_chain_fn if self._spec else self._chain_fn,
-            donate_argnums=donate,
-        )
+        # logit-poison chaos threads a traced chain-base scalar into the
+        # chain (an EXTRA operand) — a separate wrapper keeps the
+        # chaos-free jaxpr byte-identical to the pre-robustness one
+        if self._spec:
+            chain_fn = (
+                self._spec_chain_chaos_fn if self._inject_logits
+                else self._spec_chain_fn
+            )
+        else:
+            chain_fn = (
+                self._chain_chaos_fn if self._inject_logits
+                else self._chain_fn
+            )
+        self._chain = jax.jit(chain_fn, donate_argnums=donate)
         # splice: same donation as prefill (state is arg 1); the retained
         # segment (arg 2) must NEVER be donated — the index keeps serving
         # it to later requests. The two compile statics are keyword-only,
@@ -415,33 +474,66 @@ class ServeEngine:
         into every step as a scan CONSTANT (refill — the only writer —
         runs between chains), and each step's forward gathers each
         slot's factors by it (:func:`..adapters.bank.apply_lora`):
-        heterogeneous tenants decode together in this one program."""
+        heterogeneous tenants decode together in this one program.
+
+        With ``guard_nonfinite`` the scan ALSO emits a per-slot
+        per-step finite-logits flag (an ``isfinite`` reduction over the
+        logits row — the flag is DATA, the host reads it from the
+        chain's one batched fetch, never branches on it in here): the
+        poison-slot quarantine signal. Guard off, the emitted pytree —
+        and the whole jaxpr — is byte-identical to the pre-guard
+        chain."""
+        return self._chain_impl(params, state, None)
+
+    def _chain_chaos_fn(self, params, state, chain_base):
+        """Chaos twin of :meth:`_chain_fn`: ``chain_base`` (a traced
+        scalar, ``n_chains * tokens_per_launch`` at dispatch) gives the
+        injector a global decode-step index so a configured NaN lands
+        at exactly one (slot, step) — deterministic, recompile-free."""
+        return self._chain_impl(params, state, chain_base)
+
+    def _chain_impl(self, params, state, chain_base):
         kw = (
             {"adapter_ids": state["adapter_ids"]}
             if self._adapters else {}
         )
+        guard = self._guard
 
-        def step(carry, _):
+        def step(carry, x):
             cache, tok, keys, remaining = carry
             active = remaining > 0
             logits, upd = self.model.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 decode=True, mutable=["cache"], **kw,
             )
+            row = logits[:, -1].astype(jnp.float32)
+            if chain_base is not None:
+                row = chaos_lib.poison_logits(
+                    row, chain_base + x,
+                    self._chaos.nan_logit_slot, self._chaos.nan_logit_step,
+                )
             nxt, keys = sample_logits_per_slot(
-                logits[:, -1].astype(jnp.float32), keys,
+                row, keys,
                 self._temperature, self._top_k, self._top_p,
             )
             nxt = jnp.where(active, nxt, tok)
             remaining = remaining - active.astype(remaining.dtype)
-            return (upd["cache"], nxt, keys, remaining), nxt
+            out = (
+                (nxt, jnp.all(jnp.isfinite(row), axis=-1))
+                if guard else nxt
+            )
+            return (upd["cache"], nxt, keys, remaining), out
 
         carry = (
             state["cache"], state["last_tok"], state["keys"],
             state["remaining"],
         )
-        (cache, tok, keys, remaining), toks = jax.lax.scan(
-            step, carry, None, length=self.tokens_per_launch
+        xs = (
+            jnp.arange(self.tokens_per_launch)
+            if chain_base is not None else None
+        )
+        (cache, tok, keys, remaining), outs = jax.lax.scan(
+            step, carry, xs, length=self.tokens_per_launch
         )
         out = {
             "cache": cache, "last_tok": tok, "keys": keys,
@@ -449,7 +541,12 @@ class ServeEngine:
         }
         if self._adapters:
             out["adapter_ids"] = state["adapter_ids"]
-        return out, toks.T  # (n_slots, tokens_per_launch)
+        if guard:
+            toks, oks = outs
+            # (n_slots, tokens_per_launch) tokens + finite flags, ONE
+            # fetched pytree — the budget is still one fetch per chain
+            return out, (toks.T, oks.T)
+        return out, outs.T  # (n_slots, tokens_per_launch)
 
     def _spec_chain_fn(self, params, state):
         """Speculate-k decode chain: ``tokens_per_launch`` iterations of
@@ -475,18 +572,30 @@ class ServeEngine:
         compile serves every acceptance pattern. The chain emits a fixed
         (S, T, k+1) token block + (S, T) per-step emit counts; inactive
         slots emit count 0 and their history is untouched (their scatter
-        columns clamp out via ``mode="drop"``)."""
+        columns clamp out via ``mode="drop"``). ``guard_nonfinite``
+        appends a per-slot per-step finite flag over the (k+1, V) verify
+        logits, same contract as :meth:`_chain_fn`."""
+        return self._spec_chain_impl(params, state, None)
+
+    def _spec_chain_chaos_fn(self, params, state, chain_base):
+        """Chaos twin of :meth:`_spec_chain_fn` (``chain_base`` counts
+        scan ITERATIONS across chains — each iteration verifies k+1
+        positions, so the step index is per-verify, not per-token)."""
+        return self._spec_chain_impl(params, state, chain_base)
+
+    def _spec_chain_impl(self, params, state, chain_base):
         k = self._spec_k
         rows = jnp.arange(self.n_slots)
         offs = jnp.arange(k + 1)
         win = self.window
+        guard = self._guard
         # same scan-constant contract as _chain_fn
         kw = (
             {"adapter_ids": state["adapter_ids"]}
             if self._adapters else {}
         )
 
-        def step(carry, _):
+        def step(carry, x):
             cache, tok, keys, remaining, hist, hist_len = carry
             active = remaining > 0
             draft = ngram_draft(hist, hist_len, k, self._spec_ngram)
@@ -495,8 +604,14 @@ class ServeEngine:
                 {"params": params, "cache": cache}, toks_in,
                 decode=True, mutable=["cache"], **kw,
             )
+            lg = logits.astype(jnp.float32)
+            if chain_base is not None:
+                lg = chaos_lib.poison_logits(
+                    lg, chain_base + x,
+                    self._chaos.nan_logit_slot, self._chaos.nan_logit_step,
+                )
             emitted, n_acc, keys = speculative_accept(
-                logits.astype(jnp.float32), draft, keys,
+                lg, draft, keys,
                 self._temperature, self._top_k, self._top_p,
             )
             # the verify forward advanced every counter by k+1; the slot
@@ -516,14 +631,21 @@ class ServeEngine:
                 remaining - n_emit, 0
             ).astype(remaining.dtype)
             carry = (cache, new_tok, keys, remaining, hist, hist_len)
-            return carry, (emitted, n_emit)
+            out = (emitted, n_emit)
+            if guard:
+                out = out + (jnp.all(jnp.isfinite(lg), axis=(1, 2)),)
+            return carry, out
 
         carry = (
             state["cache"], state["last_tok"], state["keys"],
             state["remaining"], state["hist"], state["hist_len"],
         )
-        (cache, tok, keys, remaining, hist, hist_len), (toks, counts) = (
-            jax.lax.scan(step, carry, None, length=self.tokens_per_launch)
+        xs = (
+            jnp.arange(self.tokens_per_launch)
+            if chain_base is not None else None
+        )
+        (cache, tok, keys, remaining, hist, hist_len), outs = (
+            jax.lax.scan(step, carry, xs, length=self.tokens_per_launch)
         )
         out = {
             "cache": cache, "last_tok": tok, "keys": keys,
@@ -531,6 +653,12 @@ class ServeEngine:
         }
         if self._adapters:
             out["adapter_ids"] = state["adapter_ids"]
+        if guard:
+            toks, counts, oks = outs
+            return out, (
+                jnp.transpose(toks, (1, 0, 2)), counts.T, oks.T
+            )
+        toks, counts = outs
         # (S, T, k+1) token block + (S, T) counts
         return out, (jnp.transpose(toks, (1, 0, 2)), counts.T)
 
@@ -541,10 +669,12 @@ class ServeEngine:
     def submit(self, request: Request) -> int:
         """Enqueue one request; returns its id. Raises
         :class:`..serve.scheduler.QueueFull` when the bounded queue is at
-        capacity (backpressure) or ``ValueError`` when the request can
-        never fit the window — or names an adapter this engine cannot
-        serve (no bank, or an unregistered/out-of-range id): admission
-        failures are always synchronous, never a mid-decode surprise.
+        capacity (backpressure), :class:`..serve.scheduler.QueueClosed`
+        after :meth:`close` (shutdown), or ``ValueError`` when the
+        request can never fit the window — or names an adapter this
+        engine cannot serve (no bank, or an unregistered/out-of-range
+        id): admission failures are always synchronous, never a
+        mid-decode surprise.
 
         Admission also snapshots the adapter row's tenant-generation
         (rows recycle): :meth:`_refill` re-checks it, so a request whose
@@ -571,12 +701,14 @@ class ServeEngine:
         return self.active_slots == 0 and len(self.scheduler) == 0
 
     def step(self) -> list[Completion]:
-        """One scheduling round: refill free slots from the queue (one
-        prefill launch each), then run ONE decode chain over all slots
-        and hand out its tokens. Returns the requests that finished this
-        round (possibly mid-chain — surplus chain tokens for a finished
-        slot are discarded, exactly like ``generate()`` truncating at
-        ``max_new_tokens``)."""
+        """One scheduling round: sweep deadline/cancel state over the
+        active slots (host bookkeeping at the chain boundary — the ONLY
+        place in-flight requests are interrupted), refill free slots
+        from the queue (one prefill launch each), then run ONE decode
+        chain over all slots and hand out its tokens. Returns the
+        requests that finished this round (possibly mid-chain — surplus
+        chain tokens for a finished slot are discarded, exactly like
+        ``generate()`` truncating at ``max_new_tokens``)."""
         if self._adapters and self._bank.version != self._merged_version:
             # register/evict moved the bank since the last merge: pick
             # the new factors up BEFORE refilling, so freshly admitted
@@ -584,7 +716,7 @@ class ServeEngine:
             # slots see the new factors too — register into a free row
             # before serving it and this is a non-event for them)
             self.refresh_adapters()
-        done: list[Completion] = []
+        done: list[Completion] = list(self._sweep())
         for s in range(self.n_slots):
             if self._slots[s] is not None:
                 continue
@@ -593,17 +725,78 @@ class ServeEngine:
                 break
             done.extend(self._refill(s, req))
         if self.active_slots:
+            if self._chaos is not None:
+                chaos_lib.maybe_stall(self._chaos, self.n_chains)
+            if self._inject_logits:
+                # global decode-step base for the deterministic injector
+                # — a traced scalar, so faulty and clean chains are the
+                # same compiled program
+                args = (self.params, self._state, jnp.asarray(
+                    self.n_chains * self.tokens_per_launch, jnp.int32
+                ))
+            else:
+                args = (self.params, self._state)
             if self._spec:
-                self._state, out = self._chain(self.params, self._state)
+                self._state, out = self._chain(*args)
                 self.n_chains += 1
                 self.n_verify_forwards += self.tokens_per_launch
-                toks, counts = jax.device_get(out)  # ONE batched fetch
-                done.extend(self._distribute_spec(toks, counts))
+                fetched = jax.device_get(out)  # ONE batched fetch
+                if self._guard:
+                    toks, counts, oks = fetched
+                else:
+                    (toks, counts), oks = fetched, None
+                done.extend(self._distribute_spec(toks, counts, oks))
             else:
-                self._state, toks = self._chain(self.params, self._state)
+                self._state, out = self._chain(*args)
                 self.n_chains += 1
-                toks = jax.device_get(toks)  # the chain's ONE host fetch
-                done.extend(self._distribute(toks))
+                fetched = jax.device_get(out)  # the chain's ONE host fetch
+                if self._guard:
+                    toks, oks = fetched
+                else:
+                    toks, oks = fetched, None
+                done.extend(self._distribute(toks, oks))
+        return done
+
+    def _deadline_for(self, req: Request) -> float | None:
+        return (
+            req.deadline_s if req.deadline_s is not None
+            else self._deadline
+        )
+
+    def _sweep(self) -> list[Completion]:
+        """Chain-boundary enforcement of host-side lifecycle state:
+        complete active slots whose request was cancelled or whose
+        deadline expired. Pure host bookkeeping + the park launch —
+        never a device fetch, never a mid-chain interrupt (tokens a
+        request earned before the boundary are kept)."""
+        done: list[Completion] = []
+        if not self._cancelled and self._deadline is None and not any(
+            a is not None and a.request.deadline_s is not None
+            for a in self._slots
+        ):
+            return done
+        now = time.perf_counter()
+        for s, act in enumerate(self._slots):
+            if act is None:
+                continue
+            req = act.request
+            reason = None
+            if req.request_id in self._cancelled:
+                reason = "cancelled"
+                self._cancelled.discard(req.request_id)
+                self.n_cancelled += 1
+            else:
+                dl = self._deadline_for(req)
+                if dl is not None and now - req.submitted_s > dl:
+                    reason = "deadline"
+                    self.n_deadline_expired += 1
+            if reason is not None:
+                self._slots[s] = None
+                if act.remaining > 0:
+                    self._state["remaining"] = self._park(
+                        self._state["remaining"], s
+                    )
+                done.append(self._complete(act, reason))
         return done
 
     def run_until_idle(self, max_steps: int = 10_000) -> list[Completion]:
@@ -614,6 +807,43 @@ class ServeEngine:
                 return out
             out.extend(self.step())
         raise RuntimeError(f"not idle after {max_steps} steps")
+
+    def cancel(self, request_id: int) -> bool:
+        """Host-side cancellation. Returns True when ``request_id`` is
+        known (queued or decoding) — it will complete with
+        ``finish_reason == "cancelled"`` at the next chain/refill
+        boundary (queued: zero device work; decoding: tokens earned so
+        far are kept, the slot parks). False for ids already finished or
+        never submitted. Never interrupts a running chain and never
+        costs a device fetch — cancellation is pure bookkeeping the
+        boundary sweep enforces."""
+        known = any(
+            a is not None and a.request.request_id == request_id
+            for a in self._slots
+        ) or self.scheduler.has(request_id)
+        if known:
+            self._cancelled.add(request_id)
+        return known
+
+    @property
+    def closed(self) -> bool:
+        return self.scheduler.closed
+
+    def close(self) -> None:
+        """Stop admitting requests: every later :meth:`submit` raises
+        :class:`..serve.scheduler.QueueClosed` (synchronous
+        backpressure, like ``QueueFull``). Work already accepted —
+        queued or decoding — is unaffected; pair with :meth:`drain` for
+        a graceful shutdown. Idempotent."""
+        self.scheduler.close()
+
+    def drain(self, max_steps: int = 10_000) -> list[Completion]:
+        """Graceful shutdown: :meth:`close` the queue, then run every
+        accepted request to completion and return the completions in
+        finish order. The engine stays usable for inspection (stats,
+        counters) afterwards; it just admits nothing new."""
+        self.close()
+        return self.run_until_idle(max_steps)
 
     def _refill(self, slot: int, req: Request) -> list[Completion]:
         """Prefill ``req`` into ``slot``. One launch + one scalar fetch
@@ -641,20 +871,29 @@ class ServeEngine:
         tenant was evicted (or the row re-registered) since submit, the
         request completes here as ``"adapter_evicted"`` — zero device
         work, zero fetches — rather than decode under zeroed or, worse,
-        another tenant's factors."""
+        another tenant's factors. Cancelled or deadline-expired requests
+        complete here the same zero-work way (``"cancelled"`` /
+        ``"deadline"`` — refill is the queue's boundary, the sweep is
+        the active slots'). A prefill that RAISES is isolated to its
+        request: the slot parks, the request completes ``"error"``, and
+        the engine keeps serving everyone else — one poisoned prompt
+        (or one injected :class:`..utils.chaos.ChaosError`) must never
+        take the process down."""
+        if req.request_id in self._cancelled:
+            self._cancelled.discard(req.request_id)
+            self.n_cancelled += 1
+            return [self._complete_unstarted(req, "cancelled")]
+        dl = self._deadline_for(req)
+        if dl is not None and time.perf_counter() - req.submitted_s > dl:
+            self.n_deadline_expired += 1
+            return [self._complete_unstarted(req, "deadline")]
         aid = int(getattr(req, "adapter", 0))
         if aid and not (
             self._bank.registry.is_live(aid)
             and self._bank.generation(aid) == req.adapter_gen
         ):
             self.adapter_rejected += 1
-            return [Completion(
-                request_id=req.request_id,
-                prompt=[int(t) for t in req.prompt],
-                tokens=[],
-                finish_reason="adapter_evicted",
-                latency_s=time.perf_counter() - req.submitted_s,
-            )]
+            return [self._complete_unstarted(req, "adapter_evicted")]
         if aid:
             self.adapter_requests += 1
         prompt = [int(t) for t in req.prompt]
@@ -667,42 +906,65 @@ class ServeEngine:
             else None
         )
         grow = self.prefix is not None and tuple(pkey) not in self.prefix
-        if hit is not None:
-            depth, segment = hit
-            suffix = prompt[depth:]
-            s_bucket = bucket_len(len(suffix), self.window)
-            tokens = jnp.asarray(
-                [suffix + [0] * (s_bucket - len(suffix))], jnp.int32
+        segment = None
+        try:
+            if self._chaos is not None:
+                chaos_lib.maybe_fail_prefill(self._chaos, req.request_id)
+            if hit is not None:
+                depth, segment = hit
+                # pin the donor FIRST: in the except path below,
+                # ``segment is not None`` then always means "acquired"
+                self.prefix.acquire(segment)
+                suffix = prompt[depth:]
+                s_bucket = bucket_len(len(suffix), self.window)
+                tokens = jnp.asarray(
+                    [suffix + [0] * (s_bucket - len(suffix))], jnp.int32
+                )
+                full = (
+                    jnp.asarray(
+                        [prompt + [0] * (bucket - p_len)], jnp.int32
+                    )
+                    if self._spec
+                    else tokens  # dead operand when speculation is off
+                )
+                # aid rides as a keyword ONLY when adapters are on: the
+                # off engine's call signature (and so its jaxpr) stays
+                # identical
+                akw = {"aid": aid} if self._adapters else {}
+                self._state, first, new_seg = self._splice(
+                    self.params, self._state, segment.handle, tokens,
+                    full, depth, p_len, slot, req.seed,
+                    req.max_new_tokens, seg_len=bucket, grow=grow, **akw,
+                )
+                self.n_splices += 1
+                self.prefix_hit_tokens += depth
+            else:
+                padded = prompt + [0] * (bucket - p_len)
+                tokens = jnp.asarray([padded], jnp.int32)
+                akw = {"aid": aid} if self._adapters else {}
+                self._state, first, new_seg = self._prefill(
+                    self.params, self._state, tokens, p_len, slot,
+                    req.seed, req.max_new_tokens, **akw,
+                )
+                self.n_prefills += 1
+            if grow:
+                self.prefix.insert(
+                    tuple(pkey), new_seg, tree_nbytes(new_seg)
+                )
+            first = int(jax.device_get(first))
+        except Exception:
+            # request-level isolation: unpin any splice donor, park the
+            # slot (prefill may have set its device-side budget before
+            # raising — the park makes later chains treat it as
+            # inactive; refill rewrites the whole slot anyway) and keep
+            # serving. The fault is reported through the completion.
+            if segment is not None:
+                self.prefix.release(segment)
+            self.n_prefill_errors += 1
+            self._state["remaining"] = self._park(
+                self._state["remaining"], slot
             )
-            self.prefix.acquire(segment)
-            full = (
-                jnp.asarray([prompt + [0] * (bucket - p_len)], jnp.int32)
-                if self._spec
-                else tokens  # dead operand when speculation is off
-            )
-            # aid rides as a keyword ONLY when adapters are on: the off
-            # engine's call signature (and so its jaxpr) stays identical
-            akw = {"aid": aid} if self._adapters else {}
-            self._state, first, new_seg = self._splice(
-                self.params, self._state, segment.handle, tokens, full,
-                depth, p_len, slot, req.seed, req.max_new_tokens,
-                seg_len=bucket, grow=grow, **akw,
-            )
-            self.n_splices += 1
-            self.prefix_hit_tokens += depth
-        else:
-            segment = None
-            padded = prompt + [0] * (bucket - p_len)
-            tokens = jnp.asarray([padded], jnp.int32)
-            akw = {"aid": aid} if self._adapters else {}
-            self._state, first, new_seg = self._prefill(
-                self.params, self._state, tokens, p_len, slot, req.seed,
-                req.max_new_tokens, **akw,
-            )
-            self.n_prefills += 1
-        if grow:
-            self.prefix.insert(tuple(pkey), new_seg, tree_nbytes(new_seg))
-        first = int(jax.device_get(first))
+            return [self._complete_unstarted(req, "error")]
         self.generated_tokens += 1
         act = _Active(req, first)
         act.ttft_s = time.perf_counter() - req.submitted_s
@@ -740,18 +1002,31 @@ class ServeEngine:
         shift = ns * int(self.model.cfg.vocab_size)
         return [t + shift for t in prompt]
 
-    def _distribute(self, toks) -> list[Completion]:
+    def _distribute(self, toks, oks=None) -> list[Completion]:
         """Hand one fetched (S, T) chain block out to the slots' host
         views; free every slot that finished (budget exhausted or EOS
         mid-chain) and park early-EOS slots whose device counter still
-        shows budget."""
+        shows budget.
+
+        ``oks`` (guard on) is the fetched (S, T) finite-logits flag: the
+        first False step for a slot means that step's token — and
+        everything after it — was sampled from NaN/Inf logits. The slot
+        completes ``"nonfinite"`` with only its pre-poison tokens and is
+        quarantined (parked; the next refill rewrites the slot whole,
+        position counter included). Other slots' rows are untouched —
+        the per-slot forward is independent across the batch dim, so
+        co-scheduled requests decode token-identically to a clean run."""
         done: list[Completion] = []
         for s, act in enumerate(self._slots):
             if act is None:
                 continue
             reason = None
-            for t in toks[s, : act.remaining]:
-                tok = int(t)
+            for t, tok_ in enumerate(toks[s, : act.remaining]):
+                if oks is not None and not oks[s, t]:
+                    reason = "nonfinite"
+                    self.nonfinite_quarantined += 1
+                    break
+                tok = int(tok_)
                 act.tokens.append(tok)
                 act.remaining -= 1
                 self.generated_tokens += 1
@@ -762,27 +1037,34 @@ class ServeEngine:
                 reason = "length"
             if reason is not None:
                 self._slots[s] = None
-                if act.remaining > 0:  # finished mid-chain via EOS
+                if act.remaining > 0:  # finished mid-chain (EOS/poison)
                     self._state["remaining"] = self._park(
                         self._state["remaining"], s
                     )
                 done.append(self._complete(act, reason))
         return done
 
-    def _distribute_spec(self, toks, counts) -> list[Completion]:
+    def _distribute_spec(self, toks, counts, oks=None) -> list[Completion]:
         """Speculative twin of :meth:`_distribute`: unpack one fetched
         (S, T, k+1) block. Step t of slot s contributed ``counts[s, t]``
         real tokens — the accepted draft prefix plus the bonus/rejection
         token — and the rest of the row is padding. The host truncates at
         the request's budget exactly like ``generate()`` does (the device
         may have verified past it within the chain; those writes land in
-        the slot's own window and refill rewrites the whole slot)."""
+        the slot's own window and refill rewrites the whole slot).
+        ``oks`` follows the :meth:`_distribute` quarantine contract at
+        verify-step granularity: a poisoned verify step discards all of
+        that step's emissions."""
         done: list[Completion] = []
         for s, act in enumerate(self._slots):
             if act is None:
                 continue
             reason = None
             for t in range(counts.shape[1]):
+                if oks is not None and not oks[s, t]:
+                    reason = "nonfinite"
+                    self.nonfinite_quarantined += 1
+                    break
                 n = int(counts[s, t])
                 if n == 0:  # slot went inactive device-side
                     break
@@ -808,6 +1090,18 @@ class ServeEngine:
                     )
                 done.append(self._complete(act, reason))
         return done
+
+    def _complete_unstarted(self, req: Request, reason: str) -> Completion:
+        """A zero-token completion for a request bounced at a boundary
+        before any device work (cancelled / deadline / adapter_evicted /
+        prefill error): zero fetches, zero tokens, synchronous."""
+        return Completion(
+            request_id=req.request_id,
+            prompt=[int(t) for t in req.prompt],
+            tokens=[],
+            finish_reason=reason,
+            latency_s=time.perf_counter() - req.submitted_s,
+        )
 
     def _complete(self, act: _Active, reason: str) -> Completion:
         if act.segment is not None:
@@ -860,6 +1154,23 @@ class ServeEngine:
                 1.0 + self.spec_drafts_accepted / steps,
             "spec_acceptance_rate":
                 self.spec_drafts_accepted / (steps * self._spec_k),
+        }
+
+    def fault_stats(self) -> dict[str, int | float]:
+        """Robustness counters for the serving receipt (same pattern as
+        :meth:`spec_stats` — host bookkeeping, no device fetch):
+        configured deadline/guard/chaos state plus how much traffic each
+        failure path handled. The counters are OUTCOMES, not config —
+        regress.py fingerprints only ``chaos``/``deadline_s``/
+        ``guard_nonfinite`` so chaos rounds never gate clean rounds."""
+        return {
+            "deadline_s": float(self._deadline or 0.0),
+            "guard_nonfinite": int(self._guard),
+            "chaos": int(self._chaos is not None),
+            "deadline_expired": self.n_deadline_expired,
+            "cancelled": self.n_cancelled,
+            "nonfinite_quarantined": self.nonfinite_quarantined,
+            "prefill_errors": self.n_prefill_errors,
         }
 
     def refresh_adapters(self) -> None:

@@ -24,6 +24,13 @@ class QueueFull(Exception):
     it has accepted."""
 
 
+class QueueClosed(Exception):
+    """Raised by :meth:`FifoScheduler.submit` after :meth:`~FifoScheduler.
+    close`: the graceful-shutdown backpressure signal. Admission stops
+    synchronously; requests already queued or decoding run to
+    completion (``ServeEngine.drain``)."""
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request.
@@ -47,6 +54,12 @@ class Request:
     evicted — or the row re-registered — while the request queues, the
     engine completes it with ``finish_reason == "adapter_evicted"``
     rather than decode under the wrong factors.
+
+    ``deadline_s`` bounds submit-to-completion wall time: past it the
+    engine completes the request ``finish_reason == "deadline"`` at the
+    next chain/refill boundary (partial tokens kept — never a mid-chain
+    interrupt). ``None`` falls back to the engine's
+    ``default_deadline_s`` (itself ``None`` = no deadline).
     """
 
     prompt: Any
@@ -54,6 +67,7 @@ class Request:
     seed: int = 0
     eos_token: int | None = None
     adapter: int = 0
+    deadline_s: float | None = None
     # engine-assigned bookkeeping (not caller inputs)
     request_id: int = -1
     submitted_s: float = 0.0
@@ -68,12 +82,22 @@ class Completion:
     submit-to-first-token (the prefill/splice fetch) — the pair the
     serving receipt reports as p50/p95. ``"adapter_evicted"`` means the
     request's tenant was evicted (or its bank row re-registered) while
-    it queued: zero tokens were generated — resubmit under a live id."""
+    it queued: zero tokens were generated — resubmit under a live id.
+
+    Robustness outcomes (ISSUE 9): ``"deadline"`` — the request's
+    deadline expired (tokens generated before expiry are kept);
+    ``"cancelled"`` — the caller cancelled it host-side;
+    ``"nonfinite"`` — the request drove logits to NaN/Inf and its slot
+    was quarantined (tokens up to the poisoned step are kept);
+    ``"error"`` — prefill raised and the request was isolated (zero
+    tokens; the engine keeps serving)."""
 
     request_id: int
     prompt: list[int]
     tokens: list[int]
-    finish_reason: str  # "length" | "eos" | "adapter_evicted"
+    # "length" | "eos" | "adapter_evicted" | "deadline" | "cancelled"
+    # | "nonfinite" | "error"
+    finish_reason: str
     latency_s: float
     ttft_s: float = 0.0
 
@@ -96,19 +120,39 @@ class FifoScheduler:
         self.max_queue = max_queue
         self._queue: collections.deque[Request] = collections.deque()
         self._next_id = 0
+        self.closed = False
 
     def __len__(self) -> int:
         return len(self._queue)
 
+    def close(self) -> None:
+        """Stop admitting: every later :meth:`submit` raises
+        :class:`QueueClosed`. Queued requests stay queued — the engine
+        drains them (graceful shutdown leaves no accepted request
+        behind). Idempotent."""
+        self.closed = True
+
+    def has(self, request_id: int) -> bool:
+        """True while ``request_id`` is still queued (not yet popped
+        into a slot). O(queue) host scan — cancellation-path only."""
+        return any(r.request_id == request_id for r in self._queue)
+
     def submit(self, request: Request) -> int:
         """Validate + enqueue; returns the assigned request id. Raises
+        :class:`QueueClosed` after :meth:`close` (shutdown),
         :class:`QueueFull` (backpressure) or ``ValueError`` (a request
         that can never be served at this window)."""
+        if self.closed:
+            raise QueueClosed(
+                "scheduler is closed (draining); no new requests admitted"
+            )
         p_len = len(request.prompt)
         if p_len < 1:
             raise ValueError("prompt must contain at least one token")
         if request.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if request.deadline_s is not None and request.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0 (None = no deadline)")
         if p_len + request.max_new_tokens > self.window:
             raise ValueError(
                 f"prompt ({p_len}) + max_new_tokens "

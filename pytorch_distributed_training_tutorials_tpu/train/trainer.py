@@ -43,6 +43,7 @@ from pytorch_distributed_training_tutorials_tpu.parallel.data_parallel import (
     DataParallel,
 )
 from pytorch_distributed_training_tutorials_tpu.obs.metrics import MetricsLogger
+from pytorch_distributed_training_tutorials_tpu.utils import chaos as chaos_lib
 from pytorch_distributed_training_tutorials_tpu.utils.logging import epoch_line
 
 
@@ -181,20 +182,66 @@ def _make_loss_fn(
     return loss_fn
 
 
-def _apply_update(state: TrainState, grads, loss_val, new_stats, has_batch_stats):
+def _apply_update(
+    state: TrainState,
+    grads,
+    loss_val,
+    new_stats,
+    has_batch_stats,
+    skip_nonfinite: bool = False,
+    chaos=None,
+):
     """The optimizer-update tail shared by the plain and gradient-
-    accumulation steps — one place owns tx.update/apply/replace/metrics."""
+    accumulation steps — one place owns tx.update/apply/replace/metrics.
+
+    ``skip_nonfinite`` adds the ISSUE 9 skip-step guard: when the loss or
+    ANY gradient leaf is non-finite, the whole update is elided via a
+    ``jnp.where`` tree-select — params, opt_state and batch_stats come out
+    bitwise equal to the incoming state and ``step`` does not advance. The
+    finite flag is DATA (graftcheck ``traced-control-flow`` clean) and the
+    guard sits AFTER ``tx.update``, so it composes with any optax chain
+    and with :func:`..ops.fused_optim.fused_adamw` unchanged (the fused
+    kernel's aliased mu/nu buffers are reverted the same way — XLA copies
+    live donated inputs, so the old values are still available to the
+    select). Metrics gain a ``"skipped"`` 0/1 device scalar ONLY when the
+    guard is on — guard-off programs keep a byte-identical jaxpr.
+
+    ``chaos`` (a :class:`..utils.chaos.ChaosConfig` poisoning grads)
+    injects NaN gradients at the configured ``TrainState.step`` BEFORE the
+    update — the fault the guard is tested against, landing exactly where
+    a real non-finite backward reduction would."""
+    if chaos is not None and chaos.poisons_grads:
+        grads = chaos_lib.poison_grads(grads, state.step, chaos.nan_grad_step)
     updates, new_opt_state = state.tx.update(
         grads, state.opt_state, state.params
     )
     new_params = optax.apply_updates(state.params, updates)
+    metrics = {"loss": loss_val}
+    if skip_nonfinite:
+        ok = jnp.isfinite(loss_val)
+        for g in jax.tree_util.tree_leaves(grads):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+
+        def select(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(ok, n, o), new, old
+            )
+
+        new_params = select(new_params, state.params)
+        new_opt_state = select(new_opt_state, state.opt_state)
+        if has_batch_stats and new_stats is not None:
+            new_stats = select(new_stats, state.batch_stats)
+        step_inc = ok.astype(state.step.dtype)
+        metrics["skipped"] = jnp.int32(1) - step_inc.astype(jnp.int32)
+    else:
+        step_inc = 1
     new_state = state.replace(
-        step=state.step + 1,
+        step=state.step + step_inc,
         params=new_params,
         opt_state=new_opt_state,
         batch_stats=new_stats if has_batch_stats else state.batch_stats,
     )
-    return new_state, {"loss": loss_val}
+    return new_state, metrics
 
 
 def _train_step_fn(
@@ -202,10 +249,14 @@ def _train_step_fn(
     has_batch_stats: bool = False,
     aux_loss_weight: float = 0.0,
     model_kwargs: dict | None = None,
+    skip_nonfinite: bool = False,
+    chaos=None,
 ):
     """The raw (unjitted) SPMD train step, shared by :func:`make_train_step`
     (jit per step — streaming loaders) and :func:`make_epoch_scan` (one jit
-    per epoch — device-resident datasets)."""
+    per epoch — device-resident datasets). ``skip_nonfinite``/``chaos``
+    thread through to :func:`_apply_update` (the skip-step guard and the
+    NaN-grad injector)."""
     loss_fn = _make_loss_fn(
         loss, has_batch_stats, aux_loss_weight, model_kwargs
     )
@@ -215,7 +266,8 @@ def _train_step_fn(
             loss_fn, has_aux=True
         )(state.params, state, batch)
         return _apply_update(
-            state, grads, loss_val, new_stats, has_batch_stats
+            state, grads, loss_val, new_stats, has_batch_stats,
+            skip_nonfinite=skip_nonfinite, chaos=chaos,
         )
 
     return step_fn
@@ -227,6 +279,8 @@ def make_train_step(
     aux_loss_weight: float = 0.0,
     grad_accum_steps: int = 1,
     model_kwargs: dict | None = None,
+    skip_nonfinite: bool = False,
+    chaos=None,
 ):
     """Build the jitted SPMD train step (donated state).
 
@@ -257,13 +311,23 @@ def make_train_step(
     ``model_kwargs`` forwards extra trace-time keywords to every model
     apply (see :func:`_make_loss_fn`) — the LoRA fine-tune path pins
     ``{"adapter_ids": tid}`` this way.
+
+    ``skip_nonfinite`` turns on the skip-step guard (see
+    :func:`_apply_update`): a non-finite loss/grad leaves the returned
+    state bitwise equal to the input (step included) and the metrics dict
+    gains a ``"skipped"`` 0/1 device scalar. With gradient accumulation
+    the guard checks the AVERAGED gradients — one poisoned microbatch
+    skips the whole optimizer step, matching what folding it in would
+    have corrupted. ``chaos`` injects the tested fault
+    (:class:`..utils.chaos.ChaosConfig`).
     """
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
     if grad_accum_steps == 1:
         return jax.jit(
             _train_step_fn(
-                loss, has_batch_stats, aux_loss_weight, model_kwargs
+                loss, has_batch_stats, aux_loss_weight, model_kwargs,
+                skip_nonfinite=skip_nonfinite, chaos=chaos,
             ),
             donate_argnums=0,
         )
@@ -325,7 +389,8 @@ def make_train_step(
             else None
         )
         return _apply_update(
-            state, grads, l_sum * inv, new_stats, has_batch_stats
+            state, grads, l_sum * inv, new_stats, has_batch_stats,
+            skip_nonfinite=skip_nonfinite, chaos=chaos,
         )
 
     return jax.jit(step_fn, donate_argnums=0)
@@ -338,6 +403,8 @@ def make_epoch_scan(
     transform=None,
     unroll: int = 1,
     pregather: bool = False,
+    skip_nonfinite: bool = False,
+    chaos=None,
 ):
     """Build a jitted *whole-epoch* program: ``lax.scan`` of the train step
     over a device-resident dataset.
@@ -358,6 +425,11 @@ def make_epoch_scan(
     pairs halved). Costs compile time roughly linearly; 1 (no unroll) keeps
     test-suite compiles fast.
 
+    ``skip_nonfinite``/``chaos`` thread through to the scanned step (same
+    guard as :func:`make_train_step`; the per-step ``"skipped"`` scalar is
+    not carried out of the scan — a skipped step is visible as
+    ``state.step`` advancing by less than the steps run).
+
     ``pregather`` hoists the row gather OUT of the scan body: one epoch-wide
     take reshapes the resident dataset to ``(steps, B, ...)`` and the scan
     consumes contiguous leading-axis slices instead of doing a 512-row
@@ -370,7 +442,10 @@ def make_epoch_scan(
     before enabling. What DID move the headline is ``unroll=8`` on this
     scan (BENCH_r05).
     """
-    step_fn = _train_step_fn(loss, has_batch_stats, aux_loss_weight)
+    step_fn = _train_step_fn(
+        loss, has_batch_stats, aux_loss_weight,
+        skip_nonfinite=skip_nonfinite, chaos=chaos,
+    )
 
     def epoch_fn(state: TrainState, idx, data):
         def body(state, batch):
@@ -487,6 +562,11 @@ class Trainer:
         quiet: bool = False,
         on_step=None,
         on_epoch=None,
+        skip_nonfinite: bool = False,
+        chaos=None,
+        rollback_spike_factor: float | None = None,
+        rollback_patience: int = 2,
+        rollback_ema: float = 0.9,
     ):
         self.model = model
         self.loader = train_loader
@@ -502,11 +582,52 @@ class Trainer:
             model, optimizer, sample, strategy=self.strategy, seed=seed
         )
         self.has_batch_stats = self.state.batch_stats is not None
+        # -- ISSUE 9 training guardrails ------------------------------------
+        # skip_nonfinite: jnp.where-elide the optimizer update on any
+        # non-finite loss/grad (see _apply_update) — the per-step
+        # "skipped" 0/1 device scalar rides the MetricsLogger batched
+        # drain (log_step extra), never a per-step sync.
+        # chaos: a utils.chaos.ChaosConfig — deterministic fault injection
+        # (NaN grads at a step, spiked monitor loss) for the tests.
+        # rollback_spike_factor: when the monitored loss exceeds
+        # factor x its EMA (or is non-finite) for `rollback_patience`
+        # consecutive observations, restore the latest `save()` target and
+        # continue (restore-and-continue: the data position — self.epoch —
+        # is kept, only the state rolls back). The monitor observes host
+        # floats: per step on the streaming path, per chunk on the chunked
+        # path, per epoch on the scanned path — opting in costs that fetch
+        # cadence (documented price; rollback needs loss visibility).
+        self.skip_nonfinite = skip_nonfinite
+        self.chaos = chaos
+        if rollback_spike_factor is not None and rollback_spike_factor <= 1:
+            raise ValueError(
+                f"rollback_spike_factor must be > 1 (None = off), got "
+                f"{rollback_spike_factor}"
+            )
+        if rollback_patience < 1:
+            raise ValueError(
+                f"rollback_patience must be >= 1, got {rollback_patience}"
+            )
+        if not 0.0 <= rollback_ema < 1.0:
+            raise ValueError(
+                f"rollback_ema must be in [0, 1), got {rollback_ema}"
+            )
+        self._rb_factor = rollback_spike_factor
+        self._rb_patience = rollback_patience
+        self._rb_decay = rollback_ema
+        self._rb_ema = None  # EMA of healthy monitored losses
+        self._rb_strikes = 0  # consecutive spike observations
+        self._monitor_steps = 0  # monotonic host counter, never replays
+        self._dispatches = 0  # monotonic step-dispatch counter (batch chaos)
+        self.rollbacks = 0
+        self._last_ckpt = None  # latest save() target (rollback restores it)
         self.train_step = make_train_step(
             loss=loss,
             has_batch_stats=self.has_batch_stats,
             aux_loss_weight=aux_loss_weight,
             grad_accum_steps=grad_accum_steps,
+            skip_nonfinite=skip_nonfinite,
+            chaos=chaos,
         )
         if grad_accum_steps > 1 and getattr(
             train_loader, "device_arrays", None
@@ -616,6 +737,8 @@ class Trainer:
                 transform=loader.transform,
                 unroll=self.scan_unroll,
                 pregather=self.pregather,
+                skip_nonfinite=self.skip_nonfinite,
+                chaos=self.chaos,
             )
         self.metrics.say(
             epoch_line(
@@ -629,6 +752,8 @@ class Trainer:
             self.state, idx, loader.device_arrays
         )
         loss = float(losses[-1])  # host fetch: the honest end-of-epoch sync
+        if self._rb_factor is not None:
+            self._monitor_loss(loss)  # per-epoch granularity on this path
         dt = time.perf_counter() - t0
         return self._epoch_metrics(epoch, loss, len(loader), dt)
 
@@ -654,6 +779,8 @@ class Trainer:
                 transform=loader.transform,
                 unroll=self.scan_unroll,
                 pregather=self.pregather,
+                skip_nonfinite=self.skip_nonfinite,
+                chaos=self.chaos,
             )
         idx = jnp.concatenate(
             [
@@ -703,7 +830,8 @@ class Trainer:
         )
         if self._chunk_scan is None:
             step_fn = _train_step_fn(
-                self.loss_name, self.has_batch_stats, self.aux_loss_weight
+                self.loss_name, self.has_batch_stats, self.aux_loss_weight,
+                skip_nonfinite=self.skip_nonfinite, chaos=self.chaos,
             )
             transform = loader.transform
 
@@ -734,6 +862,10 @@ class Trainer:
                 next_log = steps + self.log_every
             if self.on_step is not None:
                 self.on_step(steps, chunk_losses[-1])
+            if self._rb_factor is not None:
+                # per-chunk granularity (one fetch per compiled launch)
+                if self._monitor_loss(float(chunk_losses[-1])):
+                    break  # rolled back: abandon the rest of this epoch
         self.last_epoch_losses = losses[-1] if losses else None
         if self.defer_host_fetch:
             # completion sync only — no D2H (see defer_host_fetch in
@@ -779,18 +911,32 @@ class Trainer:
         for batch in self.loader:
             if not isinstance(batch, tuple):
                 batch = (batch,)
+            self._dispatches += 1
+            if self.chaos is not None and self.chaos.poisons_batch:
+                batch = chaos_lib.maybe_poison_batch(
+                    self.chaos, self._dispatches, batch
+                )
             self.state, metrics = self.train_step(self.state, batch)
             loss = metrics["loss"]
             steps += 1
             # device scalar retained un-fetched; the verbose line is the
-            # log_every opt-in and costs its one historical loss fetch
+            # log_every opt-in and costs its one historical loss fetch.
+            # The skip-step counter (guard on only) rides the same batched
+            # drain as the loss — still no per-step sync.
             self.metrics.log_step(
                 steps, loss,
                 verbose=bool(self.log_every)
                 and steps % self.log_every == 0,
+                extra=(
+                    {"skipped": metrics["skipped"]}
+                    if "skipped" in metrics else None
+                ),
             )
             if self.on_step is not None:
                 self.on_step(steps, loss)
+            if self._rb_factor is not None:
+                # rollback opted in: per-step loss visibility is its price
+                self._monitor_loss(float(loss))
         jax.block_until_ready(self.state.params)
         dt = time.perf_counter() - t0
         return self._epoch_metrics(epoch, loss, steps, dt)
@@ -820,6 +966,79 @@ class Trainer:
             self.epoch = epoch + 1
         return self.last_epoch_metrics
 
+    # -- loss-spike rollback (ISSUE 9 guardrail) ---------------------------
+    def _monitor_loss(self, loss_value: float) -> bool:
+        """Feed one host-float loss observation to the spike monitor;
+        returns True when it triggered a rollback. A spike is a value
+        exceeding ``rollback_spike_factor`` x the EMA of healthy
+        observations (or any non-finite value); ``rollback_patience``
+        consecutive spikes trigger. Spiky observations are NEVER folded
+        into the EMA (a sustained spike must not normalize itself), and
+        the monitor's host step counter is monotonic across rollbacks —
+        a chaos-injected spike keyed to it cannot re-fire after the
+        restore (the livelock a state.step-keyed injector would hit)."""
+        import math
+
+        self._monitor_steps += 1
+        if self.chaos is not None:
+            loss_value = chaos_lib.host_spike_loss(
+                loss_value, self._monitor_steps, self.chaos
+            )
+        spike = not math.isfinite(loss_value) or (
+            self._rb_ema is not None
+            and loss_value > self._rb_factor * self._rb_ema
+        )
+        if spike:
+            self._rb_strikes += 1
+            if self._rb_strikes >= self._rb_patience:
+                self._do_rollback(loss_value)
+                return True
+            return False
+        self._rb_strikes = 0
+        d = self._rb_decay
+        self._rb_ema = (
+            loss_value if self._rb_ema is None
+            else d * self._rb_ema + (1.0 - d) * loss_value
+        )
+        return False
+
+    def _do_rollback(self, loss_value: float) -> None:
+        """Restore the latest ``save()`` target and continue training.
+
+        Restore-and-continue semantics: the TrainState (params/opt/step)
+        rolls back; the data position (``self.epoch``) does NOT — the
+        batches that drove the spike are skipped, not replayed, which is
+        both the standard divergence recovery and what keeps a
+        deterministic spike from re-firing. The monitor resets (EMA and
+        strikes) so post-restore losses re-seed it."""
+        if self._last_ckpt is None:
+            raise RuntimeError(
+                "loss-spike rollback triggered but no checkpoint exists — "
+                "call save() at least once (e.g. per epoch) when "
+                "rollback_spike_factor is set"
+            )
+        epoch_now = self.epoch
+        self.restore(self._last_ckpt)
+        self.epoch = epoch_now  # keep the data position (skip, don't replay)
+        self.rollbacks += 1
+        self._rb_strikes = 0
+        self._rb_ema = None
+        self.metrics.say(
+            f"  rollback #{self.rollbacks}: loss {loss_value:.4g} spiked "
+            f">{self._rb_factor:g}x EMA for {self._rb_patience} obs — "
+            f"restored {self._last_ckpt}"
+        )
+
+    @property
+    def steps_skipped(self) -> int:
+        """Total skip-step elisions recorded so far (``skip_nonfinite``
+        path). Flushes the metrics logger — i.e. performs its batched
+        drain fetch — so call at receipt/epoch boundaries, not per step."""
+        self.metrics.flush()
+        return int(
+            sum(e.get("skipped", 0) for e in self.metrics.step_events())
+        )
+
     # -- checkpoint / resume (SURVEY.md section 5.4 gap fix) ---------------
     def _state_tree(self) -> dict:
         import numpy as np
@@ -836,23 +1055,102 @@ class Trainer:
             tree["batch_stats"] = self.state.batch_stats
         return tree
 
-    def save(self, path) -> None:
+    def save(self, path, keep: int | None = None) -> None:
         """Sharded checkpoint of params/optimizer/step/epoch (orbax —
-        each host writes only its addressable shards)."""
+        each host writes only its addressable shards). ATOMIC either way
+        (ISSUE 9): a crash mid-save can never corrupt the latest restore
+        target, which the rollback leg and restart-resume both depend on.
+
+        ``keep=None`` (default): ``path`` is one checkpoint, overwritten
+        atomically — the new tree lands in ``path + ".tmp"`` first, the
+        previous checkpoint is parked at ``path + ".old"`` while the tmp
+        renames into place, then the parked copy is deleted. At every
+        instant either ``path`` or ``path + ".old"`` is a COMPLETE
+        checkpoint (:meth:`restore` falls back to ``.old`` when ``path``
+        is missing). Plain ``save_checkpoint`` would not give this:
+        orbax's ``force=True`` removes the old directory BEFORE writing.
+
+        ``keep=K``: ``path`` is a rotation directory of
+        ``ckpt-{step:08d}`` children; each save writes a fresh child
+        (tmp + rename — atomic because the target never pre-exists) and
+        prunes all but the newest K. :meth:`restore` pointed at the
+        directory resolves the newest child.
+
+        Either form records the written target as the rollback restore
+        point (``rollback_spike_factor``)."""
+        import os
+        import shutil
+
         from pytorch_distributed_training_tutorials_tpu.parallel.auto import (
             save_checkpoint,
         )
 
-        save_checkpoint(path, self._state_tree())
+        path = os.path.abspath(os.fspath(path))
+        if keep is not None:
+            if keep < 1:
+                raise ValueError(f"keep must be >= 1 (None = single), got {keep}")
+            os.makedirs(path, exist_ok=True)
+            name = f"ckpt-{int(self.state.step):08d}"
+            target = os.path.join(path, name)
+            tmp = target + ".tmp"
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)  # stale crash residue
+            save_checkpoint(tmp, self._state_tree())
+            if os.path.exists(target):
+                shutil.rmtree(target)  # re-save at the same step
+            os.rename(tmp, target)
+            kids = sorted(
+                d for d in os.listdir(path)
+                if d.startswith("ckpt-") and not d.endswith(".tmp")
+            )
+            for d in kids[:-keep]:
+                shutil.rmtree(os.path.join(path, d))
+        else:
+            tmp, old = path + ".tmp", path + ".old"
+            for stale in (tmp, old):
+                if os.path.exists(stale):
+                    shutil.rmtree(stale)  # crash residue from a prior save
+            save_checkpoint(tmp, self._state_tree())
+            if os.path.exists(path):
+                os.rename(path, old)
+            os.rename(tmp, path)
+            if os.path.exists(old):
+                shutil.rmtree(old)
+        self._last_ckpt = path
+
+    @staticmethod
+    def _resolve_ckpt(path) -> str:
+        """Map a restore path onto the atomic-save layout: a rotation
+        directory resolves to its newest ``ckpt-*`` child; a missing
+        single-checkpoint path falls back to the ``.old`` parked copy
+        (present exactly when a crash hit the rename window)."""
+        import os
+
+        path = os.path.abspath(os.fspath(path))
+        if os.path.isdir(path):
+            kids = sorted(
+                d for d in os.listdir(path)
+                if d.startswith("ckpt-") and not d.endswith(".tmp")
+            )
+            if kids:
+                return os.path.join(path, kids[-1])
+        if not os.path.exists(path) and os.path.exists(path + ".old"):
+            return path + ".old"
+        return path
 
     def restore(self, path) -> None:
         """Restore in place, preserving the current sharding layout (the
-        template tree's shardings drive orbax's placement)."""
+        template tree's shardings drive orbax's placement). Accepts a
+        plain checkpoint, a ``save(keep=K)`` rotation directory (newest
+        child wins), or a crash-windowed single path (``.old``
+        fallback)."""
         from pytorch_distributed_training_tutorials_tpu.parallel.auto import (
             restore_checkpoint,
         )
 
-        restored = restore_checkpoint(path, like=self._state_tree())
+        restored = restore_checkpoint(
+            self._resolve_ckpt(path), like=self._state_tree()
+        )
         self.epoch = int(restored.pop("epoch"))
         self.state = self.state.replace(**restored)
 

@@ -1,0 +1,199 @@
+"""Deterministic fault injection for the robustness layer (ISSUE 9).
+
+The failure paths this repo guards — poison-slot quarantine in
+:mod:`..serve.engine`, skip-step / loss-spike rollback in
+:mod:`..train.trainer`, request-level prefill isolation — would
+otherwise only ever run when real hardware misbehaves. This module
+makes them testable on the 8-device CPU mesh: a :class:`ChaosConfig`
+names *exactly where* a fault lands (slot, step, request id, chain
+index) and the injectors fire there and nowhere else, so every chaos
+test is reproducible bit-for-bit run to run.
+
+Two injector families:
+
+- **Device-side** (:func:`poison_logits`, :func:`poison_grads`): pure
+  ``jnp.where`` selects inside compiled code — the fault condition is
+  DATA (a traced step counter), never Python control flow, so the
+  graftcheck ``traced-control-flow`` rule holds and nothing recompiles
+  between faulty and clean steps. These are how a NaN *enters* the
+  compiled program; the guards under test are how it is contained.
+- **Host-side** (:func:`maybe_fail_prefill`, :func:`maybe_stall`,
+  :func:`host_spike_loss`): plain Python against host counters —
+  raise-at-prefill exercises request-level isolation, the simulated
+  launch stall exercises deadline expiry without wall-clock flakiness,
+  and the loss spike drives the Trainer's rollback monitor (host-keyed
+  so a post-rollback replay does not re-trigger the same spike — the
+  restore-and-continue semantics rollback implements).
+
+The module is jax-free at import (``jax.numpy`` is imported inside the
+device-side injectors only when they run): host-only consumers — the
+scheduler tests, the selftest argument parser — can use configs without
+touching XLA, per the import-purity hard rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+class ChaosError(RuntimeError):
+    """The injected prefill failure (:func:`maybe_fail_prefill`). A
+    distinct type so tests can assert the engine survived *this* fault
+    rather than swallowing an unrelated bug."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Where faults land. ``-1`` (the default) disables an injector.
+
+    - ``nan_logit_slot`` / ``nan_logit_step``: overwrite that slot's
+      logits row with NaN at that global decode-step index (the engine
+      counts scan iterations across chains: chain ``c``'s iteration
+      ``i`` is step ``c * tokens_per_launch + i``).
+    - ``nan_grad_step``: replace every gradient leaf with NaN at that
+      ``TrainState.step`` value (device-side, survives grad-accum — the
+      poison lands on the averaged grads). NOTE: with the skip-step
+      guard on, ``step`` freezes at the poisoned value, so this injector
+      re-fires on every later attempt — state stays protected (the
+      guard's whole point) but no further update ever applies. Use it
+      for single-step bitwise assertions; for continue-after-fault runs
+      use ``nan_batch_step``.
+    - ``nan_batch_step``: poison the input batch (first leaf all-NaN) at
+      that 1-based host dispatch index — host-keyed and monotonic, so it
+      fires exactly ONCE even though the skipped step leaves
+      ``TrainState.step`` unchanged (the guarded run continues and its
+      final model equals a clean run with that one update elided).
+    - ``spike_loss_step`` / ``spike_loss_len`` / ``spike_loss_factor``:
+      multiply the loss the Trainer's rollback monitor SEES for
+      ``spike_loss_len`` consecutive host steps starting at host step
+      ``spike_loss_step`` (1-based, monotonic across rollbacks).
+    - ``fail_prefill_request``: raise :class:`ChaosError` when the
+      engine is about to prefill that request id.
+    - ``stall_chain`` / ``stall_s``: sleep ``stall_s`` seconds before
+      dispatching chain index ``stall_chain`` — a deterministic stand-in
+      for the multi-second launch stalls CLAUDE.md documents.
+    - ``seed`` rides into receipts/fingerprints so chaos runs are
+      self-describing; the injectors themselves are deterministic.
+    """
+
+    nan_logit_slot: int = -1
+    nan_logit_step: int = -1
+    nan_grad_step: int = -1
+    nan_batch_step: int = -1
+    spike_loss_step: int = -1
+    spike_loss_len: int = 1
+    spike_loss_factor: float = 100.0
+    fail_prefill_request: int = -1
+    stall_chain: int = -1
+    stall_s: float = 0.0
+    seed: int = 0
+
+    @property
+    def poisons_logits(self) -> bool:
+        return self.nan_logit_slot >= 0 and self.nan_logit_step >= 0
+
+    @property
+    def poisons_grads(self) -> bool:
+        return self.nan_grad_step >= 0
+
+    @property
+    def poisons_batch(self) -> bool:
+        return self.nan_batch_step >= 1
+
+    @property
+    def spikes_loss(self) -> bool:
+        return self.spike_loss_step >= 0
+
+    @property
+    def fails_prefill(self) -> bool:
+        return self.fail_prefill_request >= 0
+
+    @property
+    def stalls(self) -> bool:
+        return self.stall_chain >= 0 and self.stall_s > 0
+
+
+# ---------------------------------------------------------------- device side
+
+
+def poison_logits(logits, step_index, slot: int, step: int):
+    """Return ``logits`` with row ``slot`` set to NaN when the traced
+    ``step_index`` equals ``step`` — a ``jnp.where`` select, so the
+    fault condition is data and the clean-step program is the same
+    program. ``logits`` is the per-slot row block, shape
+    ``(n_slots, ...)``; ``slot``/``step`` are Python ints from the
+    config (compile-time constants)."""
+    import jax.numpy as jnp
+
+    poisoned = logits.at[slot].set(jnp.nan)
+    return jnp.where(step_index == step, poisoned, logits)
+
+
+def poison_grads(grads, step_counter, step: int):
+    """Return ``grads`` with every leaf NaN when the traced training
+    ``step_counter`` equals ``step`` (otherwise untouched). Lands after
+    grad-accum averaging, so the skip-step guard sees exactly what a
+    real non-finite reduction would produce."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(g):
+        return jnp.where(step_counter == step, jnp.full_like(g, jnp.nan),
+                         g)
+
+    return jax.tree_util.tree_map(leaf, grads)
+
+
+# ------------------------------------------------------------------ host side
+
+
+def maybe_poison_batch(cfg: ChaosConfig, host_step: int, batch):
+    """Return ``batch`` with its first leaf all-NaN when ``host_step``
+    (the Trainer's 1-based, monotonic dispatch counter) matches
+    ``nan_batch_step``; the batch unchanged otherwise. Elementwise
+    multiply, so the leaf keeps its mesh sharding — the NaN flows
+    forward into the loss/grads exactly as a corrupt data batch would,
+    and the host key guarantees a single firing (see the class
+    docstring's livelock note on ``nan_grad_step``)."""
+    if not (cfg.poisons_batch and host_step == cfg.nan_batch_step):
+        return batch
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    leaves[0] = leaves[0] * jnp.nan
+    return treedef.unflatten(leaves)
+
+
+def maybe_fail_prefill(cfg: ChaosConfig, request_id: int) -> None:
+    """Raise :class:`ChaosError` when ``request_id`` is the configured
+    prefill victim. Called by the engine just before it dispatches the
+    prefill/splice for a request."""
+    if cfg.fails_prefill and request_id == cfg.fail_prefill_request:
+        raise ChaosError(
+            f"injected prefill failure for request {request_id}"
+        )
+
+
+def maybe_stall(cfg: ChaosConfig, chain_index: int) -> None:
+    """Sleep ``stall_s`` before the configured chain index — wall time
+    passes (deadlines expire) with zero device-side effect, mimicking a
+    launch stall."""
+    if cfg.stalls and chain_index == cfg.stall_chain:
+        time.sleep(cfg.stall_s)
+
+
+def host_spike_loss(loss_value: float, host_step: int,
+                    cfg: ChaosConfig) -> float:
+    """The loss value the rollback monitor should see at ``host_step``
+    (1-based, never replayed): spiked by ``spike_loss_factor`` inside
+    the configured window, untouched outside it. Host-only — the
+    compiled step and the real training state never see the spike."""
+    if cfg.spikes_loss and (
+        cfg.spike_loss_step
+        <= host_step
+        < cfg.spike_loss_step + cfg.spike_loss_len
+    ):
+        return float(loss_value) * cfg.spike_loss_factor
+    return float(loss_value)

@@ -125,3 +125,110 @@ def test_device_op_durations_parses_trace(tmp_path):
     assert all(v > 0 for v in durations.values())
     vals = list(durations.values())
     assert vals == sorted(vals, reverse=True)  # descending
+
+
+# ------------------------------------- atomic checkpoints + rollback (ISSUE 9)
+
+def test_save_is_atomic_no_residue_and_overwrite(tmp_path):
+    """save() lands via temp-dir + rename: after any completed save there
+    is no .tmp/.old residue, and overwriting an existing checkpoint
+    round-trips the NEW state (orbax's force=True delete-then-write
+    window is closed by the swap)."""
+    ck = str(tmp_path / "ck")
+    a = _trainer()
+    a.train(1)
+    a.save(ck)
+    assert os.path.isdir(ck)
+    assert not os.path.exists(ck + ".tmp") and not os.path.exists(ck + ".old")
+    a.train(2)
+    a.save(ck)  # overwrite path: rename-swap, not delete-then-write
+    assert os.path.isdir(ck)
+    assert not os.path.exists(ck + ".tmp") and not os.path.exists(ck + ".old")
+    b = _trainer(seed=9)
+    b.restore(ck)
+    assert b.epoch == 2
+    assert int(b.state.step) == int(a.state.step)
+
+
+def test_restore_falls_back_to_old_checkpoint(tmp_path):
+    """The crash-window contract: if a save died between the two renames
+    (only ``path.old`` exists), restore() uses it — at every instant one
+    complete checkpoint is loadable."""
+    ck = str(tmp_path / "ck")
+    a = _trainer()
+    a.train(2)
+    a.save(ck)
+    os.rename(ck, ck + ".old")  # simulate dying mid-swap
+    b = _trainer(seed=9)
+    b.restore(ck)
+    assert b.epoch == 2
+    assert int(b.state.step) == int(a.state.step)
+
+
+def test_save_keep_rotation_and_newest_restore(tmp_path):
+    """save(path, keep=K) rotates ``ckpt-{step:08d}`` children, pruning
+    to the K newest; restore(path) on the directory resolves the newest
+    child."""
+    root = str(tmp_path / "rot")
+    a = _trainer()
+    a.train(1)
+    a.save(root, keep=2)
+    a.train(2)
+    a.save(root, keep=2)
+    a.train(3)
+    a.save(root, keep=2)
+    kids = sorted(
+        d for d in os.listdir(root) if d.startswith("ckpt-")
+    )
+    assert len(kids) == 2
+    assert kids[-1] == f"ckpt-{int(a.state.step):08d}"
+    b = _trainer(seed=9)
+    b.restore(root)  # newest child
+    assert b.epoch == 3
+    assert int(b.state.step) == int(a.state.step)
+
+
+def test_loss_spike_rollback_restores_and_continues(tmp_path):
+    """The ISSUE 9 rollback pin: a sustained (injected) loss spike past
+    factor x EMA for `patience` consecutive observations restores the
+    latest checkpoint and training CONTINUES — epoch position preserved
+    (skip the bad region, don't replay it), exactly one rollback, and
+    the run finishes with a finite loss."""
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    ck = str(tmp_path / "ck")
+    t = Trainer(
+        MLP(features=(32, 4)),
+        ShardedLoader(make_cls_dataset(), 8, create_mesh({"data": 8}),
+                      seed=0),
+        optax.adam(1e-3), loss="cross_entropy", quiet=True,
+        rollback_spike_factor=10.0, rollback_patience=2,
+        chaos=ChaosConfig(spike_loss_step=6, spike_loss_len=3,
+                          spike_loss_factor=1e6),
+    )
+    t.train(1)  # 4 steps/epoch: healthy monitor steps 1-4 seed the EMA
+    t.save(ck)
+    t.train(3)  # spike window hits monitor steps 6-8 -> strikes at 6,7
+    assert t.rollbacks == 1
+    assert t.epoch == 3  # continued to the end, no epoch replay
+    assert np.isfinite(t.last_epoch_metrics["loss"])
+
+
+def test_rollback_without_checkpoint_raises():
+    """Spiking with no prior save() is a hard error — silently training
+    on from a corrupted state is the one thing rollback exists to
+    prevent."""
+    import pytest
+
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    t = Trainer(
+        MLP(features=(32, 4)),
+        ShardedLoader(make_cls_dataset(), 8, create_mesh({"data": 8}),
+                      seed=0),
+        optax.adam(1e-3), loss="cross_entropy", quiet=True,
+        rollback_spike_factor=10.0, rollback_patience=1,
+        chaos=ChaosConfig(spike_loss_step=2, spike_loss_factor=1e6),
+    )
+    with pytest.raises(RuntimeError, match="no checkpoint"):
+        t.train(1)

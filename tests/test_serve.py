@@ -46,8 +46,20 @@ The load-bearing pins:
   constant), the fetch budget is unchanged, admission rejects dead ids
   at submit, and prefix-cache keys are tenant-scoped — two tenants
   sharing a prompt never splice from each other's cache;
+- the robustness layer (ISSUE 9) is INVISIBLE until a fault lands:
+  guard/deadline-on engines with no faults are byte-identical to the
+  plain engine and ``generate()`` with zero extra compiles and the
+  UNCHANGED fetch budget (chains + prefills + splices); an injected
+  NaN (``utils.chaos``) quarantines exactly the poisoned slot
+  (``"nonfinite"``, pre-poison tokens kept) while co-scheduled slots
+  stay token-identical to a clean run; deadlines and host-side
+  ``cancel`` complete at chain/refill boundaries only; ``close`` /
+  ``drain`` give ``QueueClosed`` backpressure and run every accepted
+  request to completion; a prefill that raises is isolated to its
+  request (``"error"``) and the engine keeps serving;
 - ``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` succeeds in a
-  subprocess (the tier-1 wiring for the end-to-end smoke).
+  subprocess (the tier-1 wiring for the end-to-end smoke), and the
+  ``--chaos`` arm exercises the fault paths end to end.
 """
 
 import json
@@ -1116,6 +1128,264 @@ def test_adapter_evicted_while_queued(model_params):
     assert len(done2.tokens) == 6
 
 
+# ------------------------------------------------- robustness (ISSUE 9)
+
+def test_robustness_on_no_faults_token_exact(model_params):
+    """The acceptance pin: guard_nonfinite + a generous deadline with NO
+    faults is invisible — per-request tokens byte-identical to the plain
+    engine and to one-shot generate(), zero extra compiles (the finite
+    flag is a scan output of the SAME chain program, never a new
+    trace)."""
+    model, params = model_params
+    reqs = [(_prompt(3000 + i, 4 + 3 * i), 6 + 2 * i) for i in range(4)]
+
+    def run(**kwargs):
+        engine = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=4, **kwargs
+        )
+        for p, n in reqs:
+            engine.submit(Request(prompt=p, max_new_tokens=n))
+        done = {c.request_id: c for c in engine.run_until_idle()}
+        return engine, done
+
+    plain_eng, plain = run()
+    guard_eng, guarded = run(guard_nonfinite=True, default_deadline_s=300.0)
+    assert plain.keys() == guarded.keys()
+    for rid in plain:
+        assert guarded[rid].tokens == plain[rid].tokens
+        assert guarded[rid].finish_reason == plain[rid].finish_reason
+    for (p, n), rid in zip(reqs, sorted(plain)):
+        assert guarded[rid].tokens == _reference(model, params, p, n)
+    # same number of compiled programs as the plain engine
+    assert (guard_eng._chain._cache_size()
+            == plain_eng._chain._cache_size() == 1)
+    assert (guard_eng._prefill._cache_size()
+            == plain_eng._prefill._cache_size())
+    stats = guard_eng.fault_stats()
+    assert stats["guard_nonfinite"] == 1 and stats["chaos"] == 0
+    assert stats["nonfinite_quarantined"] == 0
+    assert stats["deadline_expired"] == 0 and stats["cancelled"] == 0
+
+
+def test_robustness_fetch_budget(model_params, monkeypatch):
+    """guard + deadline + cancel sweeps cost ZERO extra fetches: the
+    finite flags ride the chain's one batched fetch, the sweep is pure
+    host bookkeeping — budget stays chains + prefills + splices."""
+    model, params = model_params
+    prompts = [_prompt(3100 + i, 5 + 2 * i) for i in range(4)]
+    calls = {"n": 0}
+    real_get = jax.device_get
+    monkeypatch.setattr(
+        jax, "device_get",
+        lambda x: (calls.__setitem__("n", calls["n"] + 1), real_get(x))[1],
+    )
+    engine = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=4,
+        guard_nonfinite=True, default_deadline_s=300.0,
+    )
+    rids = [
+        engine.submit(Request(prompt=p, max_new_tokens=10))
+        for p in prompts
+    ]
+    engine.cancel(rids[-1])  # queued cancel: completes with zero fetches
+    done = engine.run_until_idle()
+    assert len(done) == 4
+    assert calls["n"] == engine.n_chains + engine.n_prefills
+
+
+def test_nonfinite_quarantine_isolates_slot(model_params):
+    """An injected NaN logits row poisons exactly one slot: that request
+    completes ``"nonfinite"`` with a strict prefix of its clean tokens,
+    while the co-scheduled slot's request stays byte-identical to a
+    chaos-free run — the fault never crosses the slot boundary."""
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    model, params = model_params
+    reqs = [(_prompt(3200, 5), 12), (_prompt(3201, 8), 12)]
+
+    def run(chaos=None):
+        engine = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=4,
+            guard_nonfinite=True, chaos=chaos,
+        )
+        for p, n in reqs:
+            engine.submit(Request(prompt=p, max_new_tokens=n))
+        return engine, {c.request_id: c for c in engine.run_until_idle()}
+
+    _, clean = run()
+    # poison slot 0 (request 0, FIFO refill) at global decode step 2
+    engine, faulty = run(ChaosConfig(nan_logit_slot=0, nan_logit_step=2))
+    assert faulty[0].finish_reason == "nonfinite"
+    assert 0 < len(faulty[0].tokens) < len(clean[0].tokens)
+    assert faulty[0].tokens == clean[0].tokens[: len(faulty[0].tokens)]
+    # the co-scheduled slot never sees the fault
+    assert faulty[1].tokens == clean[1].tokens
+    assert faulty[1].finish_reason == clean[1].finish_reason == "length"
+    stats = engine.fault_stats()
+    assert stats["nonfinite_quarantined"] == 1 and stats["chaos"] == 1
+
+
+def test_deadline_queued_and_active(model_params):
+    """Deadlines fire at both boundaries: a queued request whose budget
+    expired completes ``"deadline"`` at refill with zero device work; an
+    ACTIVE request caught by an (injected) launch stall completes at the
+    next chain boundary keeping the tokens it already earned."""
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    model, params = model_params
+    # queued expiry: the deadline is tiny, refill sees it already dead
+    engine = ServeEngine(model, params, n_slots=1, tokens_per_launch=4)
+    rid = engine.submit(Request(
+        prompt=_prompt(3300, 5), max_new_tokens=6, deadline_s=1e-6,
+    ))
+    (done,) = engine.run_until_idle()
+    assert done.request_id == rid
+    assert done.finish_reason == "deadline" and done.tokens == []
+    assert engine.n_prefills == 0 and engine.n_chains == 0
+    assert engine.fault_stats()["deadline_expired"] == 1
+
+    # active expiry: chain 1 stalls past the deadline; the sweep at the
+    # next boundary completes the request with its pre-stall tokens
+    engine = ServeEngine(
+        model, params, n_slots=1, tokens_per_launch=4,
+        chaos=ChaosConfig(stall_chain=1, stall_s=0.3),
+    )
+    rid = engine.submit(Request(
+        prompt=_prompt(3301, 5), max_new_tokens=12, deadline_s=0.25,
+    ))
+    (done,) = engine.run_until_idle()
+    assert done.request_id == rid
+    assert done.finish_reason == "deadline"
+    assert 0 < len(done.tokens) < 12  # partial progress kept
+    assert engine.fault_stats()["deadline_expired"] == 1
+
+
+def test_cancel_queued_and_active(model_params):
+    """Host-side cancel: a queued request completes ``"cancelled"`` with
+    zero tokens at refill; an active one at the next chain boundary with
+    its partial tokens; an unknown/finished id returns False."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=4)
+    r0 = engine.submit(Request(prompt=_prompt(3400, 5), max_new_tokens=16))
+    r1 = engine.submit(Request(prompt=_prompt(3401, 5), max_new_tokens=6))
+    assert engine.cancel(r1) is True  # still queued
+    assert engine.cancel(999) is False  # unknown id
+    first = engine.step()  # prefill r0 + one chain; r1 dies at refill
+    cancelled = [c for c in first if c.request_id == r1]
+    assert cancelled and cancelled[0].finish_reason == "cancelled"
+    assert cancelled[0].tokens == []
+    assert engine.cancel(r0) is True  # active now: boundary cancel
+    done = {c.request_id: c for c in engine.run_until_idle()}
+    assert done[r0].finish_reason == "cancelled"
+    assert 0 < len(done[r0].tokens) < 16  # earned tokens kept
+    assert engine.cancel(r0) is False  # already finished
+    assert engine.fault_stats()["cancelled"] == 2
+
+
+def test_close_and_drain(model_params):
+    """Graceful shutdown: close() turns submit into QueueClosed
+    backpressure, drain() runs every accepted request to completion —
+    no accepted request is ever dropped."""
+    from pytorch_distributed_training_tutorials_tpu.serve import QueueClosed
+
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=1, tokens_per_launch=4)
+    rids = [
+        engine.submit(Request(prompt=_prompt(3500 + i, 4), max_new_tokens=5))
+        for i in range(3)
+    ]
+    done = engine.drain()
+    assert engine.closed
+    assert sorted(c.request_id for c in done) == rids
+    assert all(len(c.tokens) == 5 for c in done)
+    with pytest.raises(QueueClosed):
+        engine.submit(Request(prompt=_prompt(3510, 4), max_new_tokens=5))
+    assert engine.idle
+
+
+def test_prefill_error_isolated(model_params):
+    """A prefill that raises is that REQUEST's failure, not the
+    engine's: it completes ``"error"`` with zero tokens and the engine
+    keeps serving everyone else token-exactly."""
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    model, params = model_params
+    reqs = [(_prompt(3600 + i, 5), 6) for i in range(3)]
+    plain = ServeEngine(model, params, n_slots=1, tokens_per_launch=4)
+    for p, n in reqs:
+        plain.submit(Request(prompt=p, max_new_tokens=n))
+    clean = {c.request_id: c for c in plain.run_until_idle()}
+
+    engine = ServeEngine(
+        model, params, n_slots=1, tokens_per_launch=4,
+        chaos=ChaosConfig(fail_prefill_request=1),
+    )
+    for p, n in reqs:
+        engine.submit(Request(prompt=p, max_new_tokens=n))
+    done = {c.request_id: c for c in engine.run_until_idle()}
+    assert done[1].finish_reason == "error" and done[1].tokens == []
+    for rid in (0, 2):
+        assert done[rid].tokens == clean[rid].tokens
+        assert done[rid].finish_reason == "length"
+    assert engine.fault_stats()["prefill_errors"] == 1
+
+
+def test_spec_guard_quarantine_composed(model_params):
+    """The guard composes with speculation: the poisoned slot
+    quarantines out of the (S, T, k+1) verify block while the
+    co-scheduled slot stays byte-identical to the clean spec run."""
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    model, params = model_params
+    reqs = [(_prompt(3700, 5), 12), (_prompt(3701, 8), 12)]
+
+    def run(chaos=None):
+        engine = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=4,
+            speculative_k=2, guard_nonfinite=True, chaos=chaos,
+        )
+        for p, n in reqs:
+            engine.submit(Request(prompt=p, max_new_tokens=n))
+        return engine, {c.request_id: c for c in engine.run_until_idle()}
+
+    _, clean = run()
+    engine, faulty = run(ChaosConfig(nan_logit_slot=0, nan_logit_step=2))
+    assert faulty[0].finish_reason == "nonfinite"
+    assert faulty[0].tokens == clean[0].tokens[: len(faulty[0].tokens)]
+    assert faulty[1].tokens == clean[1].tokens
+    assert engine.fault_stats()["nonfinite_quarantined"] == 1
+
+
+def test_robustness_off_state_is_unchanged(model_params):
+    """guard/deadline/chaos OFF keeps the slot-state tree (and so the
+    compiled programs) byte-identical to the pre-robustness engine —
+    and even guard ON adds NO state leaves (the finite flag is a chain
+    output, not carried state)."""
+    model, params = model_params
+    base_keys = {"cache", "last_tok", "keys", "remaining"}
+    assert set(ServeEngine(model, params, n_slots=2)._state) == base_keys
+    guarded = ServeEngine(
+        model, params, n_slots=2, guard_nonfinite=True,
+        default_deadline_s=60.0,
+    )
+    assert set(guarded._state) == base_keys
+
+
+def test_robustness_validation(model_params):
+    """Bad lifecycle params bounce synchronously at construction/submit."""
+    model, params = model_params
+    with pytest.raises(ValueError):
+        ServeEngine(model, params, default_deadline_s=0.0)
+    with pytest.raises(ValueError):
+        ServeEngine(model, params, default_deadline_s=-1.0)
+    engine = ServeEngine(model, params, n_slots=1)
+    with pytest.raises(ValueError):
+        engine.submit(Request(
+            prompt=[1, 2], max_new_tokens=2, deadline_s=0.0,
+        ))
+    assert engine.idle
+
+
 # ------------------------------------------------------------- the selftest
 
 def test_serve_selftest_subprocess(tmp_path):
@@ -1147,4 +1417,35 @@ def test_serve_selftest_subprocess(tmp_path):
     # to dedicated engines + the base model, admission enforced
     assert receipt["adapter_token_exact"] is True
     assert receipt["adapters"] == 1 and receipt["adapter_requests"] >= 1
+    assert load_receipt(json_path)["ok"] is True
+
+
+def test_serve_selftest_chaos_subprocess(tmp_path):
+    """``--selftest --chaos`` — the fault-injection arm (ISSUE 9): one
+    quarantined slot with a co-scheduled request token-exact to the
+    clean engine, a deadline expiry, a cancellation, QueueClosed after
+    drain, the unchanged fetch budget, and one skipped training step —
+    all counted into the receipt."""
+    from pytorch_distributed_training_tutorials_tpu.obs import load_receipt, validate_receipt
+
+    json_path = str(tmp_path / "selftest_chaos.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_training_tutorials_tpu.serve", "--selftest",
+         "--chaos", "--json", json_path],
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+        env=os.environ.copy(),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    receipt = json.loads(out.stdout.strip().splitlines()[-1])
+    assert receipt["ok"] is True, receipt.get("problems")
+    assert validate_receipt(receipt, kind="serve_selftest") == []
+    assert receipt["chaos"] == 1 and receipt["guard_nonfinite"] == 1
+    assert receipt["nonfinite_quarantined"] == 1
+    assert receipt["deadline_expired"] == 1
+    assert receipt["cancelled"] == 1
+    assert receipt["chaos_token_exact"] is True
+    # budget = chains + prefills + splices, already enforced inside the
+    # selftest (a violation flips ok=False); the count is informational
+    assert receipt["chaos_host_fetches"] >= 1
+    assert receipt["steps_skipped"] == 1
     assert load_receipt(json_path)["ok"] is True

@@ -287,6 +287,45 @@ def test_traced_control_flow_catches_python_branch_on_adapter_id():
     assert not hits(check(clean), "traced-control-flow")
 
 
+def test_traced_control_flow_catches_python_branch_on_finite_flag():
+    """The robustness foot-gun (ISSUE 9): the per-slot finite-logits flag
+    and the skip-step ok flag are DATA computed inside compiled code — a
+    Python branch on either (quarantine decision, update-vs-skip) would
+    crash on the tracer or force a recompile per outcome. The jnp.where
+    twins (what serve/engine.py's guard and trainer.py's _apply_update
+    actually do) must stay silent."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def update(state, grads, loss):
+            if jnp.isfinite(loss):      # the finite flag is data!
+                state = state + grads
+            return state
+    """
+    found = hits(check(src), "traced-control-flow")
+    assert len(found) == 1 and found[0].line == 7
+
+    clean = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def update(state, grads, loss):
+            ok = jnp.isfinite(loss)
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(grads)))
+            new = state + grads
+            return jnp.where(ok, new, state)   # select, not branch
+
+        @jax.jit
+        def chain_guard(logits):
+            # the quarantine flag rides the scan output, never a branch
+            return jnp.all(jnp.isfinite(logits), axis=-1)
+    """
+    assert not hits(check(clean), "traced-control-flow")
+
+
 # -------------------------------------------------------------- host-sync-hazard
 
 def test_host_sync_fires_inside_jit():

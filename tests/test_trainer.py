@@ -271,3 +271,137 @@ def test_scan_unroll_matches_unroll1():
     # scheduling knob, not a numerics knob — but fusion boundaries may move,
     # so allow ulp-level drift rather than asserting bit-identity
     np.testing.assert_allclose(losses[1], losses[3], rtol=1e-6)
+
+
+# ------------------------------------------------ skip-step guard (ISSUE 9)
+
+def _guard_trainer(seed=0, **kw):
+    """Linear regression on 8 steps/epoch — enough steps that a mid-epoch
+    fault has healthy steps on both sides."""
+    mesh = create_mesh({"data": 8})
+    loader = ShardedLoader(
+        _make_learnable_regression(), 32, mesh, seed=0
+    )
+    return Trainer(
+        LinearRegressor(), loader, optax.adam(1e-2), loss="mse",
+        seed=seed, quiet=True, **kw,
+    )
+
+
+def test_skip_step_elides_poisoned_update_and_continues():
+    """The ISSUE 9 training acceptance pin: a run with one injected
+    non-finite batch (host-keyed, fires exactly once) skips exactly that
+    update and its final model is IDENTICAL to a clean run with the same
+    update manually elided — training continues, nothing else changes."""
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    leaves = jax.tree_util.tree_leaves
+    t_guard = _guard_trainer(
+        skip_nonfinite=True, chaos=ChaosConfig(nan_batch_step=3)
+    )
+    t_guard.train(1)
+    assert t_guard.steps_skipped == 1
+    assert int(t_guard.state.step) == 7  # 8 dispatches, 1 elided
+    assert all(
+        np.all(np.isfinite(np.asarray(l)))
+        for l in leaves(t_guard.state.params)
+    )
+    # reference: the same epoch with update 3 manually elided
+    t_ref = _guard_trainer()
+    t_ref.loader.set_epoch(0)
+    for i, batch in enumerate(t_ref.loader, start=1):
+        if i == 3:
+            continue
+        t_ref.state, _ = t_ref.train_step(t_ref.state, batch)
+    for la, lb in zip(
+        leaves(t_guard.state.params), leaves(t_ref.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_skip_step_guard_off_path_identical():
+    """skip_nonfinite=True with NO faults changes nothing: params after a
+    full epoch are bitwise equal to the guard-off trainer and the skip
+    counter stays zero."""
+    leaves = jax.tree_util.tree_leaves
+    t_a = _guard_trainer(skip_nonfinite=True)
+    t_b = _guard_trainer()
+    t_a.train(1)
+    t_b.train(1)
+    for la, lb in zip(
+        leaves(t_a.state.params), leaves(t_b.state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    assert t_a.steps_skipped == 0
+
+
+def test_skip_step_state_bitwise_unchanged_on_poisoned_step():
+    """Single-step bitwise pin, device-side grad poison: params,
+    opt_state, AND step are unchanged through a poisoned update — the
+    jnp.where select protects every leaf, including Adam moments."""
+    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
+        create_train_state,
+        make_train_step,
+    )
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    leaves = jax.tree_util.tree_leaves
+    mesh = create_mesh({"data": 8})
+    dp = DataParallel(mesh)
+    model = LinearRegressor(in_dim=4)
+    x = np.arange(32 * 4, dtype=np.float32).reshape(32, 4) / 100.0
+    y = np.ones((32, 1), np.float32)
+    state = create_train_state(model, optax.adam(1e-2), x[:8], strategy=dp)
+    step = make_train_step(
+        loss="mse", skip_nonfinite=True, chaos=ChaosConfig(nan_grad_step=0)
+    )
+    before = jax.device_get((state.params, state.opt_state, state.step))
+    new_state, m = step(
+        state, (dp.shard_batch(x), dp.shard_batch(y))
+    )
+    after = jax.device_get(
+        (new_state.params, new_state.opt_state, new_state.step)
+    )
+    for a, b in zip(leaves(before), leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(jax.device_get(m["skipped"])) == 1
+
+
+def test_skip_step_through_grad_accum_and_fused_adamw():
+    """The guard composes with both optimizer paths ISSUE 9 names: a
+    poisoned step through grad-accum microbatching and through
+    fused_adamw's one-pass update leaves state bitwise unchanged (the
+    where-select happens AFTER the fused update, on fresh buffers)."""
+    from pytorch_distributed_training_tutorials_tpu.ops.fused_optim import fused_adamw
+    from pytorch_distributed_training_tutorials_tpu.train.trainer import (
+        create_train_state,
+        make_train_step,
+    )
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    leaves = jax.tree_util.tree_leaves
+    mesh = create_mesh({"data": 8})
+    dp = DataParallel(mesh)
+    model = LinearRegressor(in_dim=4)
+    x = np.arange(32 * 4, dtype=np.float32).reshape(32, 4) / 100.0
+    y = np.ones((32, 1), np.float32)
+    for tx, accum in (
+        (optax.adam(1e-2), 2),          # grad-accum path
+        (fused_adamw(1e-2), 1),         # fused one-pass path
+        (fused_adamw(1e-2), 2),         # both at once
+    ):
+        state = create_train_state(model, tx, x[:8], strategy=dp)
+        step = make_train_step(
+            loss="mse", grad_accum_steps=accum, skip_nonfinite=True,
+            chaos=ChaosConfig(nan_grad_step=0),
+        )
+        before = jax.device_get((state.params, state.opt_state, state.step))
+        new_state, m = step(
+            state, (dp.shard_batch(x), dp.shard_batch(y))
+        )
+        after = jax.device_get(
+            (new_state.params, new_state.opt_state, new_state.step)
+        )
+        for a, b in zip(leaves(before), leaves(after)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(jax.device_get(m["skipped"])) == 1
