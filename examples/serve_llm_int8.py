@@ -288,6 +288,14 @@ def main():
         "nonfinite_quarantined)",
     )
     ap.add_argument(
+        "--flight-log", default=None, dest="flight_log",
+        help="for --server: write graft-flightlog/v1 snapshots (fault "
+        "auto-dumps + one end-of-stream dump) to this JSONL path; render "
+        "with scripts/flight_view.py. The recorder itself is always on "
+        "for --server (host-only, zero extra device fetches) — this "
+        "flag only adds the on-disk dump",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -569,7 +577,15 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     import jax
     import numpy as np
 
+    from pytorch_distributed_training_tutorials_tpu.obs import FlightRecorder
     from pytorch_distributed_training_tutorials_tpu.serve import Request, ServeEngine
+
+    # flight recorder (ISSUE 10): always on for the server arm — host
+    # bookkeeping only, zero extra device fetches — so every serving
+    # receipt carries streaming-histogram percentiles and the lifecycle
+    # counters. --flight-log additionally dumps graft-flightlog/v1
+    # snapshots (fault auto-dumps + one end-of-stream dump) to disk.
+    flight = FlightRecorder(capacity=4096, dump_path=args.flight_log)
 
     bank = None
     if args.adapters:
@@ -591,6 +607,9 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
                     ).astype(np.float32),
                     bank.row_zeros(),
                 ),
+            )
+            flight.record(
+                "adapter_register", adapter=aid, tenant=f"tenant-{aid}"
             )
 
     window = int(cfg.max_seq_len)
@@ -618,6 +637,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         spec_ngram=args.spec_ngram,
         adapter_bank=bank,
         default_deadline_s=args.deadline_s,
+        flight=flight,
     )
     rng = np.random.Generator(np.random.PCG64(11))
     # one shared token family: request i's prompt = shared[:k] + tail,
@@ -660,19 +680,25 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     engine.nonfinite_quarantined = engine.n_prefill_errors = 0
     if engine.prefix is not None:
         engine.prefix.hits = engine.prefix.misses = 0
+    # the warmup's compile-dominated spans would poison the percentile
+    # histograms — reset the recorder with the counters above
+    flight.reset()
 
     t0 = time.perf_counter()
     for i in range(args.requests):
         engine.submit(mk_request(len(lengths) + i))
-    completions = engine.run_until_idle()
+    engine.run_until_idle()
     # the drain's last chain ended in a real fetch (engine.step's
     # device_get), but close the region explicitly so wall-clock honesty
     # doesn't hinge on engine internals
     jax.device_get(engine._state["remaining"])
     wall_s = time.perf_counter() - t0
 
-    lat = np.asarray(sorted(c.latency_s for c in completions))
-    ttft = np.asarray(sorted(c.ttft_s for c in completions))
+    # percentiles come from the recorder's streaming histograms (bounded
+    # memory, mergeable across processes) rather than sorting the
+    # completion list — same samples (the engine records each
+    # Completion's own latency/ttft), bounded-error buckets
+    lat_h, ttft_h = flight.hist["e2e"], flight.hist["ttft"]
     toks = engine.generated_tokens
     receipt.update(
         server=True,
@@ -688,19 +714,20 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         server_generated_tokens=toks,
         server_chains=engine.n_chains,
         server_prefills=engine.n_prefills,
-        server_p50_latency_s=round(float(np.percentile(lat, 50)), 3),
-        server_p95_latency_s=round(float(np.percentile(lat, 95)), 3),
-        server_ttft_p50_s=round(float(np.percentile(ttft, 50)), 3),
-        server_ttft_p95_s=round(float(np.percentile(ttft, 95)), 3),
+        server_p50_latency_s=round(lat_h.quantile(0.50), 3),
+        server_p95_latency_s=round(lat_h.quantile(0.95), 3),
+        server_ttft_p50_s=round(ttft_h.quantile(0.50), 3),
+        server_ttft_p95_s=round(ttft_h.quantile(0.95), 3),
         server_compile_s=round(compile_s, 1),
         prefix_overlap=args.prefix_overlap,
         prefix_cache_mb=cache_mb,
-        **engine.prefix_stats(),
-        **engine.spec_stats(),
-        **engine.adapter_stats(),
-        **engine.fault_stats(),
+        **engine.stats(),
         backend=jax.default_backend(),
     )
+    if args.flight_log:
+        # end-of-stream snapshot (fault auto-dumps already appended)
+        flight.dump(reason="end_of_stream")
+        print(f"flight log -> {args.flight_log}")
     prefix_note = ""
     if engine.prefix is not None:
         st = engine.prefix_stats()

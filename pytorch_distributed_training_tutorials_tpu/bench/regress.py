@@ -12,10 +12,13 @@ falls more than ``--tolerance`` below the best earlier round.
 
 Scope decisions that keep the cut honest:
 
-- only HIGHER-IS-BETTER rate metrics are gated (tok/s families + MFU +
-  the bench headline ``value`` when its ``unit`` is a rate) — latency
-  and wall-clock fields stay informational, their noise floor on the
-  tunneled runtime is launch/stall-bound (CLAUDE.md);
+- HIGHER-IS-BETTER rate metrics are gated (tok/s families + MFU + the
+  bench headline ``value`` when its ``unit`` is a rate), and — since the
+  flight recorder made the tails stable (ISSUE 10) — so are the
+  LOWER-IS-BETTER p95 latency metrics (``LATENCY_METRICS``): the latest
+  round must stay within ``(1 + tolerance) *`` the lowest earlier p95.
+  p50s and wall-clock fields stay informational, their noise floor on
+  the tunneled runtime is launch/stall-bound (CLAUDE.md);
 - receipts only compare within an identical measurement config
   (preset/batch/lengths/dtype/... fingerprint): the 1b f32 and 1b-gqa
   int8 serving receipts are different experiments, not a trajectory;
@@ -53,6 +56,19 @@ RATE_METRICS = (
     "mfu",
 )
 
+# gated metrics: LOWER is better (ISSUE 10). p95 tails come from the
+# flight recorder's streaming histograms, so they are finally stable
+# enough to gate: the bucket geometry (not sort order over a noisy
+# sample) sets their resolution, and the recorder primes/fetch contract
+# keeps warmup compiles out of the sample. p50s stay informational —
+# median latency on the tunneled runtime is launch/stall-bound noise.
+LATENCY_METRICS = (
+    "server_p95_latency_s",
+    "server_ttft_p95_s",
+    "ttft_p95_s",
+    "e2e_p95_s",
+)
+
 # payload fields that identify WHAT was measured — receipts compare only
 # within an identical fingerprint
 CONFIG_FIELDS = (
@@ -79,6 +95,11 @@ CONFIG_FIELDS = (
     # stay out deliberately: they are outcomes of the traffic, not
     # configuration of the experiment
     "chaos", "deadline_s", "guard_nonfinite",
+    # flight recorder (ISSUE 10): instrumented rounds carry host-side
+    # bookkeeping in the request loop, so they never gate — or get gated
+    # by — bare rounds; the recorder's own counters (flight_events,
+    # flight_dumps, ...) stay out, outcomes not configuration
+    "flight",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")
@@ -112,7 +133,7 @@ def _round(path: str) -> int:
 
 def _metrics(payload: dict) -> dict[str, float]:
     out = {}
-    for name in RATE_METRICS:
+    for name in RATE_METRICS + LATENCY_METRICS:
         v = payload.get(name)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             out[name] = float(v)
@@ -121,6 +142,10 @@ def _metrics(payload: dict) -> dict[str, float]:
             and isinstance(unit, str) and "/s" in unit):
         out[f"value[{unit}]"] = float(v)
     return out
+
+
+def _lower_is_better(name: str) -> bool:
+    return name in LATENCY_METRICS
 
 
 def _config_key(payload: dict) -> tuple:
@@ -166,7 +191,9 @@ def collect(paths: list[str]) -> tuple[dict, list[str]]:
 
 def check(groups: dict, tolerance: float) -> list[dict]:
     """Regressions: for every key/metric with >= 2 rounds, the LATEST
-    round must reach ``(1 - tolerance) *`` the best earlier round."""
+    round must reach ``(1 - tolerance) *`` the best earlier round —
+    or, for the lower-is-better latency tails, stay within
+    ``(1 + tolerance) *`` the best (lowest) earlier round."""
     regressions = []
     for (kind, cfg), recs in groups.items():
         if len(recs) < 2:
@@ -179,16 +206,26 @@ def check(groups: dict, tolerance: float) -> list[dict]:
             ]
             if not earlier:
                 continue
-            best = max(earlier)
-            if value < best * (1.0 - tolerance):
+            if _lower_is_better(name):
+                best = min(earlier)
+                bad = value > best * (1.0 + tolerance)
+                drop = value / best - 1.0 if best > 0 else 0.0
+            else:
+                best = max(earlier)
+                bad = value < best * (1.0 - tolerance)
+                drop = 1.0 - value / best
+            if bad:
                 regressions.append({
                     "kind": kind,
                     "config": dict(cfg),
                     "metric": name,
+                    "direction": (
+                        "lower" if _lower_is_better(name) else "higher"
+                    ),
                     "best_earlier": best,
                     "latest": value,
                     "latest_path": latest["path"],
-                    "drop": 1.0 - value / best,
+                    "drop": drop,
                 })
     return regressions
 
@@ -208,12 +245,13 @@ def _print_table(groups: dict, regressions: list[dict]) -> None:
                 f"r{rd:02d} {v:g}" if rd >= 0 else f"{v:g}"
                 for rd, v, _ in traj
             )
+            arrow = " (lower is better)" if _lower_is_better(name) else ""
             status = ""
             if len(traj) == 1:
                 status = "  (single round)"
             elif (kind, name, traj[-1][2]) in bad:
                 status = "  REGRESSION"
-            print(f"  {name}: {line}{status}")
+            print(f"  {name}{arrow}: {line}{status}")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -257,10 +295,11 @@ def main(argv: list[str] | None = None) -> int:
         for s in skipped:
             print(f"skipped {s}")
         for r in regressions:
+            cmp = ">" if r.get("direction") == "lower" else "<"
             print(
                 f"REGRESSION {r['kind']}.{r['metric']}: "
-                f"{r['latest']:g} < best {r['best_earlier']:g} "
-                f"(-{100 * r['drop']:.1f}%, tolerance "
+                f"{r['latest']:g} {cmp} best {r['best_earlier']:g} "
+                f"({100 * r['drop']:+.1f}%, tolerance "
                 f"{100 * args.tolerance:.1f}%) [{r['latest_path']}]"
             )
         print(f"{len(groups)} trajectories, {len(regressions)} regressions")

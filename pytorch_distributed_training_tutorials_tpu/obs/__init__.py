@@ -16,6 +16,15 @@ CLAUDE.md prose. ``obs`` is that lore as library code, in four pillars:
 - :mod:`.receipt` — the single schema'd envelope every number-producing
   entry point writes through (git sha, jax version, mesh, drift window).
 
+Plus the production twin of the benchmarking pillars (ISSUE 10):
+
+- :mod:`.flight` — :class:`FlightRecorder`: bounded request-lifecycle
+  event ring + per-request spans + ``graft-flightlog/v1`` fault dumps,
+  host-only and budget-neutral by contract;
+- :mod:`.histogram` — :class:`LogHistogram`: streaming log2 histograms
+  with mergeable state and bounded-error p50/p95/p99 (the serving
+  percentile path — replaces sort-the-list).
+
 ``python -m pytorch_distributed_training_tutorials_tpu.obs --selftest`` smoke-runs all four on a
 tiny CPU-mesh workload.
 
@@ -45,6 +54,12 @@ _LAZY_EXPORTS = {
     "make_receipt": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
     "validate_receipt": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
     "write_receipt": "pytorch_distributed_training_tutorials_tpu.obs.receipt",
+    "EVENT_KINDS": "pytorch_distributed_training_tutorials_tpu.obs.flight",
+    "FLIGHT_SCHEMA": "pytorch_distributed_training_tutorials_tpu.obs.flight",
+    "FlightRecorder": "pytorch_distributed_training_tutorials_tpu.obs.flight",
+    "load_flightlog": "pytorch_distributed_training_tutorials_tpu.obs.flight",
+    "validate_flightlog": "pytorch_distributed_training_tutorials_tpu.obs.flight",
+    "LogHistogram": "pytorch_distributed_training_tutorials_tpu.obs.histogram",
 }
 
 __all__ = sorted(_LAZY_EXPORTS)

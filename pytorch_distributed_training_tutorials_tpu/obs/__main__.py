@@ -1,13 +1,16 @@
 """``python -m pytorch_distributed_training_tutorials_tpu.obs --selftest``: end-to-end smoke of the
 observability layer on a tiny workload.
 
-Exercises all four pillars against whatever backend is available (the
+Exercises all five pillars against whatever backend is available (the
 tier-1 test runs it on the forced 8-device CPU mesh): trains a few steps
 with a JSONL-sinked :class:`MetricsLogger`, captures a real profiler trace
 of a jitted step chain, classifies it with :class:`StepReport` (HLO-
-verified), and emits an ``obs_selftest`` receipt through the schema'd
-writer. Prints exactly one JSON line on stdout and exits non-zero on any
-validation failure — a living receipt that the pipeline works.
+verified), drives the flight-recorder pillar (histogram sharding/merge vs
+numpy percentiles, a full lifecycle span, a ``graft-flightlog/v1`` dump
+round-tripped through disk and re-validated), and emits an
+``obs_selftest`` receipt through the schema'd writer. Prints exactly one
+JSON line on stdout and exits non-zero on any validation failure — a
+living receipt that the pipeline works.
 """
 
 from __future__ import annotations
@@ -97,6 +100,71 @@ def selftest(json_path: str | None = None) -> dict:
             "unclassified (>10%)"
         )
 
+    # pillar 5: flight recorder + streaming histograms (ISSUE 10) —
+    # jax-free, so this leg runs identically on any backend
+    import math
+    import random
+
+    from pytorch_distributed_training_tutorials_tpu.obs import (
+        FlightRecorder,
+        LogHistogram,
+        load_flightlog,
+        validate_flightlog,
+    )
+
+    # histograms: shard a heavy-tailed sample over two recorders, merge,
+    # and require every quantile within the documented one-bucket bound
+    # of the exact sorted-sample value
+    rng = random.Random(7)
+    samples = [rng.lognormvariate(-3.0, 1.5) for _ in range(4000)]
+    whole = LogHistogram()
+    sharded = [LogHistogram(), LogHistogram()]
+    for i, v in enumerate(samples):
+        whole.record(v)
+        sharded[i % 2].record(v)
+    merged = sharded[0].merge(sharded[1])
+    if merged.counts != whole.counts or merged.n != whole.n:
+        problems.append("sharded histogram merge != whole-sample record")
+    svals = sorted(samples)
+    for q in (0.5, 0.95, 0.99):
+        exact = svals[max(1, math.ceil(q * len(svals))) - 1]
+        if abs(whole.quantile(q) - exact) > whole.rel_error_bound * exact:
+            problems.append(
+                f"histogram q={q} off by more than one bucket: "
+                f"{whole.quantile(q)} vs exact {exact}"
+            )
+    # flight dump round-trip: one synthetic lifecycle + a fault, dumped
+    # to disk, loaded back, re-validated
+    flight_path = os.path.join(workdir, "flight.jsonl")
+    rec = FlightRecorder(capacity=32, dump_path=flight_path)
+    rec.request_submitted(0, p_len=4, max_new=8)
+    rec.request_popped(0)
+    rec.request_prefilled(0, slot=1)
+    rec.chain_start(1, 2)
+    rec.chain_end(tokens=8, occupancy=1)
+    rec.fault("nonfinite", rid=0, slot=1, chain_step=3)
+    rec.request_completed(0, "nonfinite", tokens=3)
+    try:
+        snaps = load_flightlog(flight_path)
+        for snap in snaps:
+            validate_flightlog(snap)
+        if len(snaps) != 1:
+            problems.append(f"{len(snaps)} flight dumps, expected 1")
+        elif snaps[0]["trigger"].get("slot") != 1:
+            problems.append("flight dump trigger lost the faulty slot")
+        hist_rt = LogHistogram.from_dict(
+            json.loads(json.dumps(whole.to_dict()))
+        )
+        if hist_rt.counts != whole.counts or (
+            hist_rt.quantile(0.95) != whole.quantile(0.95)
+        ):
+            problems.append("histogram JSON round-trip changed state")
+    except ValueError as e:
+        problems.append(f"flight dump failed validation: {e}")
+    fsum = rec.summary()
+    if fsum["flight_spans_done"] != 1 or fsum["e2e_count"] != 1:
+        problems.append(f"flight summary inconsistent: {fsum}")
+
     # pillar 4: the schema'd receipt, validated before it is reported
     receipt = make_receipt(
         "obs_selftest",
@@ -105,6 +173,8 @@ def selftest(json_path: str | None = None) -> dict:
             "n_events": len(metrics.events),
             "timing": timing.to_dict(),
             "step_report": report.to_dict(),
+            "flight": fsum,
+            "hist_rel_error_bound": whole.rel_error_bound,
             "problems": problems,
             "ok": not problems,
         },

@@ -1,0 +1,347 @@
+"""Serving flight recorder: request-lifecycle events, spans, fault dumps.
+
+PR 3 built the *benchmarking* observability pillar (MinOfN, DriftBracket,
+StepReport, receipts). This module is its production twin: when the
+engine is serving a live request stream, the question is no longer "how
+fast is a step" but "what was the engine doing when slot 3 went
+nonfinite" — exactly the post-mortem ISSUE 9's quarantine/deadline paths
+create and end-of-run counters cannot answer.
+
+Three pieces, all pure host bookkeeping:
+
+- **Event ring**: a bounded ``deque`` of typed, monotonic-timestamped
+  events (``EVENT_KINDS``) stamped at the boundaries the engine already
+  touches (submit / refill / chain dispatch / sweep / complete). The
+  ring forgets old events (``dropped`` counts them) but NEVER blocks or
+  grows — a recorder must be safe to leave on for a week of traffic.
+- **Spans**: per-request lifecycle records (submit -> queue_pop ->
+  prefill/splice -> first chain -> complete) kept in a dict keyed by
+  request id, DELIBERATELY independent of the event ring so wraparound
+  cannot corrupt a live request's span. Completed spans feed the
+  streaming histograms and roll into their own bounded deque.
+- **Histograms**: :class:`~..obs.histogram.LogHistogram` streams for
+  TTFT, end-to-end latency, queue wait, and chain utilization —
+  bounded-error p50/p95/p99 without retaining the sample list.
+
+Contract with the serve/train stack (pinned by tests/test_serve.py and
+tests/test_flight.py): the recorder is host-only — stamping an event
+costs a clock read and a deque append, NEVER a device fetch, so the
+engine's fetch budget stays exactly chains + prefills + splices; a
+recorder-off engine keeps byte-identical state trees and compiled
+programs (the same off-path pattern the spec/adapter/robustness layers
+use). Timestamping here uses ``time.perf_counter()`` in a jax-free
+module — the graftcheck ``naive-timing`` rule only patrols jax-importing
+files, and tests/test_static_analysis.py pins this file as exempt.
+
+Fault dumps: on any fault_stats-visible event (nonfinite quarantine,
+deadline expiry, prefill error, adapter_evicted, trainer skip/rollback)
+the recorder snapshots the last N events + live spans as ONE schema'd
+JSONL line (``graft-flightlog/v1``), written to ``dump_path`` when set.
+``scripts/flight_view.py`` renders these as a timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import Counter, deque
+from typing import Any, Dict, List, Optional
+
+from .histogram import LogHistogram
+
+FLIGHT_SCHEMA = "graft-flightlog/v1"
+
+# The typed vocabulary; record() rejects anything else so a dump is
+# machine-readable without a per-producer schema.
+EVENT_KINDS = frozenset({
+    "submit",            # request accepted by the scheduler
+    "queue_pop",         # request left the queue for a slot
+    "prefill",           # full prefill into a slot
+    "splice",            # prefix-cache splice + suffix prefill
+    "chain_start",       # decode chain dispatched (occupancy recorded)
+    "chain_end",         # chain's batched fetch landed (tokens recorded)
+    "sweep",             # chain-boundary sweep completed requests
+    "complete",          # request finished (any finish_reason)
+    "fault",             # fault_stats-visible anomaly (slot-aware)
+    "adapter_register",  # tenant row assigned
+    "adapter_evict",     # tenant row freed
+    "adapter_refresh",   # engine re-merged a moved bank version
+    "step_skipped",      # trainer nonfinite skip (rides the batched fetch)
+    "rollback",          # trainer loss-spike rollback fired
+    "stall",             # injected launch stall (utils/chaos.py)
+})
+
+# Faults trigger an auto-dump when a dump_path is configured.
+_AUTO_DUMP_KINDS = frozenset({"fault", "step_skipped", "rollback"})
+
+
+class FlightRecorder:
+    """Bounded request-lifecycle recorder for ServeEngine / Trainer.
+
+    Parameters
+    ----------
+    capacity: event-ring size (old events drop, counted in ``dropped``).
+    dump_path: when set, fault-class events append one
+        ``graft-flightlog/v1`` JSONL snapshot here automatically;
+        :meth:`dump` can also be called explicitly (end-of-run).
+    dump_events: how many trailing events a snapshot carries.
+    max_done_spans: completed-span retention (histograms already hold
+        the aggregate; the deque is for post-mortem context only).
+    """
+
+    def __init__(self, capacity: int = 1024,
+                 dump_path: Optional[str] = None,
+                 dump_events: int = 64,
+                 max_done_spans: int = 256):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.dump_path = dump_path
+        self.dump_events = int(dump_events)
+        self.max_done_spans = int(max_done_spans)
+        self._t0 = time.perf_counter()
+        self.reset()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset(self) -> None:
+        """Forget everything (events, spans, histograms, counters) but
+        keep configuration and the epoch ``t0`` — the examples' warmup
+        phase resets the recorder alongside the engine counters so the
+        receipt reflects only the timed stream."""
+        self.events: deque = deque(maxlen=self.capacity)
+        self.n_events = 0
+        self.n_dumps = 0
+        self.n_faults = 0
+        self.kind_counts: Counter = Counter()
+        self.spans: Dict[Any, dict] = {}
+        self.done_spans: deque = deque(maxlen=self.max_done_spans)
+        self.hist = {
+            "ttft": LogHistogram(),
+            "e2e": LogHistogram(),
+            "queue_wait": LogHistogram(),
+            # utilization is a ratio in (0, 1]; finer floor, tight cap
+            "chain_util": LogHistogram(min_value=1e-3, max_value=4.0),
+        }
+
+    @property
+    def dropped(self) -> int:
+        """Events stamped but no longer in the ring (wraparound)."""
+        return self.n_events - len(self.events)
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    # -- generic intake ----------------------------------------------------
+
+    def record(self, kind: str, **fields: Any) -> dict:
+        """Stamp one typed event. Unknown kinds raise — the dump format
+        is only machine-readable if the vocabulary is closed."""
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unknown flight event kind {kind!r}; "
+                f"known: {sorted(EVENT_KINDS)}"
+            )
+        event = {"t": round(self._now(), 6), "kind": kind, **fields}
+        self.events.append(event)
+        self.n_events += 1
+        self.kind_counts[kind] += 1
+        if kind in _AUTO_DUMP_KINDS:
+            self.n_faults += 1
+            if self.dump_path is not None:
+                self.dump(reason=kind, trigger=event)
+        return event
+
+    # -- request lifecycle (ServeEngine hooks) -----------------------------
+
+    def request_submitted(self, rid: Any, p_len: int = 0,
+                          max_new: int = 0, adapter: int = 0) -> None:
+        t = self._now()
+        self.record("submit", rid=rid, p_len=p_len, max_new=max_new,
+                    adapter=adapter)
+        # spans live OUTSIDE the ring: wraparound never corrupts them
+        self.spans[rid] = {
+            "rid": rid, "submit_t": t, "p_len": p_len, "max_new": max_new,
+            "adapter": adapter,
+        }
+
+    def request_popped(self, rid: Any) -> None:
+        t = self._now()
+        self.record("queue_pop", rid=rid)
+        span = self.spans.get(rid)
+        if span is not None:
+            span["queue_pop_t"] = t
+            self.hist["queue_wait"].record(t - span["submit_t"])
+
+    def request_prefilled(self, rid: Any, slot: int,
+                          kind: str = "prefill",
+                          cached_len: int = 0) -> None:
+        """``kind`` is "prefill" or "splice" (the prefix-cache path)."""
+        t = self._now()
+        if kind == "splice":
+            self.record("splice", rid=rid, slot=slot, cached_len=cached_len)
+        else:
+            self.record("prefill", rid=rid, slot=slot)
+        span = self.spans.get(rid)
+        if span is not None:
+            span["prefill_t"] = t
+            span["slot"] = slot
+            span["path"] = kind
+            if cached_len:
+                span["cached_len"] = cached_len
+
+    def request_completed(self, rid: Any, finish_reason: str,
+                          tokens: int = 0,
+                          latency_s: Optional[float] = None,
+                          ttft_s: Optional[float] = None) -> None:
+        """Close a span. ``latency_s``/``ttft_s`` are the engine's own
+        Completion numbers when available — recording THOSE (not a
+        re-derived clock delta) keeps the histogram percentiles
+        sample-identical to the sort-based ones they replace."""
+        t = self._now()
+        self.record("complete", rid=rid, finish_reason=finish_reason,
+                    tokens=tokens)
+        span = self.spans.pop(rid, None)
+        if span is None:
+            span = {"rid": rid, "submit_t": None}
+        span["complete_t"] = t
+        span["finish_reason"] = finish_reason
+        span["tokens"] = tokens
+        e2e = latency_s
+        if e2e is None and span.get("submit_t") is not None:
+            e2e = t - span["submit_t"]
+        if e2e is not None:
+            span["e2e_s"] = round(e2e, 6)
+            self.hist["e2e"].record(e2e)
+        if ttft_s is None and span.get("submit_t") is not None \
+                and span.get("prefill_t") is not None:
+            ttft_s = span["prefill_t"] - span["submit_t"]
+        if ttft_s is not None:
+            span["ttft_s"] = round(ttft_s, 6)
+            self.hist["ttft"].record(ttft_s)
+            if e2e is not None and tokens > 1 and e2e > ttft_s:
+                span["decode_tok_per_s"] = round(
+                    (tokens - 1) / (e2e - ttft_s), 3
+                )
+        self.done_spans.append(span)
+
+    # -- engine-wide events ------------------------------------------------
+
+    def chain_start(self, occupancy: int, n_slots: int) -> None:
+        self.record("chain_start", occupancy=occupancy, n_slots=n_slots)
+        if n_slots:
+            self.hist["chain_util"].record(occupancy / n_slots)
+
+    def chain_end(self, tokens: int, occupancy: int) -> None:
+        self.record("chain_end", tokens=tokens, occupancy=occupancy)
+
+    def sweep(self, completed: int) -> None:
+        self.record("sweep", completed=completed)
+
+    def fault(self, fault_kind: str, **fields: Any) -> None:
+        """A fault_stats-visible anomaly (nonfinite / deadline /
+        prefill_error / adapter_evicted ...). Auto-dumps when a
+        ``dump_path`` is configured."""
+        self.record("fault", fault_kind=fault_kind, **fields)
+
+    # -- trainer hooks -----------------------------------------------------
+
+    def step_skipped(self, step: int) -> None:
+        """A Trainer nonfinite skip became host-visible. This fires from
+        MetricsLogger's existing batched drain — never per step."""
+        self.record("step_skipped", step=step)
+
+    def rollback(self, step: int, loss: float) -> None:
+        self.record("rollback", step=step, loss=float(loss))
+
+    # -- snapshots ---------------------------------------------------------
+
+    def snapshot(self, reason: str = "manual",
+                 trigger: Optional[dict] = None) -> dict:
+        """The ``graft-flightlog/v1`` dump object: trailing events, live
+        spans, recent completed spans, histogram state, counters."""
+        return {
+            "schema": FLIGHT_SCHEMA,
+            "reason": reason,
+            "t": round(self._now(), 6),
+            "trigger": trigger,
+            "events": list(self.events)[-self.dump_events:],
+            "live_spans": [dict(s) for s in self.spans.values()],
+            "done_spans": [dict(s) for s in self.done_spans],
+            "histograms": {k: h.to_dict() for k, h in self.hist.items()},
+            "counts": dict(self.kind_counts),
+            "n_events": self.n_events,
+            "dropped": self.dropped,
+        }
+
+    def dump(self, reason: str = "manual",
+             trigger: Optional[dict] = None) -> dict:
+        """Append one snapshot line to ``dump_path`` (JSONL) and return
+        it. With no path configured the snapshot is still built and
+        returned (the selftest asserts on it in-process)."""
+        snap = self.snapshot(reason=reason, trigger=trigger)
+        self.n_dumps += 1
+        if self.dump_path is not None:
+            with open(self.dump_path, "a") as f:
+                f.write(json.dumps(snap) + "\n")
+        return snap
+
+    # -- receipt surface ---------------------------------------------------
+
+    def summary(self) -> dict:
+        """Flat receipt-ready aggregate: recorder counters + the four
+        histogram summaries (``ttft_p95_s``-style keys)."""
+        out = {
+            "flight": 1,
+            "flight_events": self.n_events,
+            "flight_dropped": self.dropped,
+            "flight_faults": self.n_faults,
+            "flight_dumps": self.n_dumps,
+            "flight_spans_live": len(self.spans),
+            "flight_spans_done": len(self.done_spans),
+        }
+        out.update(self.hist["ttft"].summary(prefix="ttft_", unit="s"))
+        out.update(self.hist["e2e"].summary(prefix="e2e_", unit="s"))
+        out.update(
+            self.hist["queue_wait"].summary(prefix="queue_wait_", unit="s")
+        )
+        out.update(self.hist["chain_util"].summary(prefix="chain_util_"))
+        return {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in out.items()
+        }
+
+
+# -- dump-file tooling (scripts/flight_view.py + tests) --------------------
+
+def validate_flightlog(obj: dict) -> None:
+    """Raise ValueError unless ``obj`` is a well-formed flight snapshot."""
+    if not isinstance(obj, dict):
+        raise ValueError("flightlog snapshot must be a dict")
+    if obj.get("schema") != FLIGHT_SCHEMA:
+        raise ValueError(
+            f"schema mismatch: {obj.get('schema')!r} != {FLIGHT_SCHEMA!r}"
+        )
+    for key in ("reason", "t", "events", "live_spans", "done_spans",
+                "histograms", "counts"):
+        if key not in obj:
+            raise ValueError(f"flightlog snapshot missing key {key!r}")
+    for ev in obj["events"]:
+        if ev.get("kind") not in EVENT_KINDS:
+            raise ValueError(
+                f"flightlog event has unknown kind {ev.get('kind')!r}"
+            )
+
+
+def load_flightlog(path: str) -> List[dict]:
+    """Read + validate every snapshot line of a JSONL flight log."""
+    snaps = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            validate_flightlog(obj)
+            snaps.append(obj)
+    return snaps

@@ -43,6 +43,11 @@ class MetricsLogger:
         ``samples_per_sec`` (the analytic-FLOPs MFU convention —
         models.utils.model_flops_per_token, never cost_analysis on a
         scanned model, TRAIN_LLM_r05.md).
+    flight: optional :class:`..obs.flight.FlightRecorder`. Skip-step
+        observations become ``step_skipped`` flight events AT DRAIN TIME
+        — the skip flag already rides the batched fetch, so the recorder
+        learns about a skipped step without any new per-step host sync
+        (it is simply as late as the loss itself).
     """
 
     def __init__(
@@ -55,6 +60,7 @@ class MetricsLogger:
         flops_per_token: float | None = None,
         peak_flops: float | None = None,
         tokens_per_sample: int | None = None,
+        flight=None,
     ):
         self.events: collections.deque[dict] = collections.deque(
             maxlen=capacity
@@ -68,6 +74,7 @@ class MetricsLogger:
         self.flops_per_token = flops_per_token
         self.peak_flops = peak_flops
         self.tokens_per_sample = tokens_per_sample
+        self.flight = flight
         self._sink: IO[str] | None = None
 
     # -- gating ------------------------------------------------------------
@@ -143,6 +150,10 @@ class MetricsLogger:
             event = {"kind": "step", "step": step, "loss": float(val)}
             if ext:
                 event.update({k: float(v) for k, v in ext.items()})
+            if self.flight is not None and event.get("skipped"):
+                # the skip became host-visible with THIS drain; stamp it
+                # (auto-dumps when the recorder has a dump_path)
+                self.flight.step_skipped(step=event["step"])
             self._record(event)
 
     def flush(self) -> None:

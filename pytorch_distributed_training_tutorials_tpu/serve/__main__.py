@@ -35,9 +35,20 @@ co-scheduled request stays byte-identical to a fault-free run), a
 deadline expiry, a host-side cancel and a close/drain — fetch budget
 still counted — and a mini training leg drives the skip-step guard
 (poisoned batch leaves TrainState bitwise unchanged, the skip counter
-increments once). The receipt gains the ``fault_stats()`` fields plus
-``steps_skipped``. Prints exactly one JSON line (a ``graft-receipt/v1``
-envelope) and exits non-zero on any failure.
+increments once). With a recorder riding along, the chaos injectors
+auto-dump ``graft-flightlog/v1`` snapshots whose trigger names the
+quarantined slot — the post-mortem contract tests assert on. A sixth
+(``--flight``) arm replays the staggered stream through a
+:class:`..obs.flight.FlightRecorder`-instrumented engine: tokens stay
+byte-identical, the fetch budget is unchanged (the recorder is pure host
+bookkeeping), every completed request carries a FULL lifecycle span
+(submit -> queue_pop -> prefill -> complete), per-stage event counts
+reconcile with the engine's own counters, and the streaming-histogram
+p50/p95 match sort-based percentiles within one bucket's documented
+relative error. The receipt gains the ``fault_stats()`` fields plus
+``steps_skipped``, and the per-arm stats now flow through ONE
+``engine.stats(part)`` aggregate. Prints exactly one JSON line (a
+``graft-receipt/v1`` envelope) and exits non-zero on any failure.
 """
 
 from __future__ import annotations
@@ -49,7 +60,11 @@ import sys
 
 
 def selftest(json_path: str | None = None, spec_k: int = 2,
-             adapters: int = 3, chaos: bool = False) -> dict:
+             adapters: int = 3, chaos: bool = False,
+             flight: bool = False) -> dict:
+    import math
+    import tempfile
+
     import jax
     import jax.numpy as jnp
 
@@ -206,7 +221,10 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
 
     eng_off, toks_off, _ = run_stream(0)
     eng_on, toks_on, fetches_on = run_stream(16 * 1024 * 1024)
-    stats = eng_on.prefix_stats()
+    # the one stats() aggregate, part-filtered: each arm merges stats
+    # from a DIFFERENT engine, and the filter keeps e.g. eng_spec's
+    # "prefix_cache: 0" from clobbering eng_on's "prefix_cache: 1"
+    stats = eng_on.stats("prefix")
     prefix_exact = toks_on == toks_off
     if not prefix_exact:
         problems.append(
@@ -277,7 +295,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
 
     eng_plain, toks_plain, _ = run_spec_stream(0)
     eng_spec, toks_spec, fetches_spec = run_spec_stream(spec_k)
-    sstats = eng_spec.spec_stats()
+    sstats = eng_spec.stats("spec")
     spec_exact = toks_spec == toks_plain
     if not spec_exact:
         problems.append(
@@ -415,9 +433,132 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
         )
     except ValueError:
         pass
-    astats = eng_mix.adapter_stats()
+    astats = eng_mix.stats("adapters")
     if astats.get("adapter_requests", 0) < 1:
         problems.append(f"no tenant traffic recorded: {astats}")
+
+    # ------------------------------------------------------------------
+    # flight arm (--flight, ISSUE 10): the staggered base stream again,
+    # now through a FlightRecorder-instrumented engine — tokens and the
+    # fetch budget must be untouched (the recorder is host bookkeeping),
+    # every completion must carry a FULL lifecycle span, per-stage event
+    # counts must reconcile with the engine's counters, and the
+    # streaming-histogram percentiles must match sort-based ones within
+    # one bucket's documented relative error
+    # ------------------------------------------------------------------
+    flight_fields: dict = {}
+    if flight:
+        from pytorch_distributed_training_tutorials_tpu.obs import FlightRecorder
+
+        rec = FlightRecorder(capacity=256)
+        eng_f = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=8, flight=rec
+        )
+        count = {"n": 0}
+
+        def counting_f(x):
+            count["n"] += 1
+            return real_get(x)
+
+        jax.device_get = counting_f
+        try:
+            comp_f = {}
+            pending = list(prompts)
+            for toks, max_new in pending[:2]:
+                eng_f.submit(Request(prompt=toks, max_new_tokens=max_new))
+            pending = pending[2:]
+            while not eng_f.idle or pending:
+                while pending:
+                    toks, max_new = pending[0]
+                    try:
+                        eng_f.submit(
+                            Request(prompt=toks, max_new_tokens=max_new)
+                        )
+                        pending.pop(0)
+                    except QueueFull:
+                        break
+                for c in eng_f.step():
+                    comp_f[c.request_id] = c
+        finally:
+            jax.device_get = real_get
+        flight_budget = (
+            eng_f.n_chains + eng_f.n_prefills + eng_f.n_splices
+        )
+        if count["n"] > flight_budget:
+            problems.append(
+                f"flight arm: {count['n']} host fetches > "
+                f"{flight_budget} (recorder must cost zero fetches)"
+            )
+        if {r: c.tokens for r, c in comp_f.items()} != {
+            r: c.tokens for r, c in completions.items()
+        }:
+            problems.append("flight recorder changed greedy tokens")
+        spans = {s.get("rid"): s for s in rec.done_spans}
+        span_keys = (
+            "submit_t", "queue_pop_t", "prefill_t", "complete_t",
+            "finish_reason",
+        )
+        span_full = len(spans) == len(prompts) and all(
+            all(k in s for k in span_keys) for s in spans.values()
+        )
+        if not span_full:
+            problems.append(
+                f"flight arm: incomplete lifecycle spans: "
+                f"{sorted(spans)} over {len(prompts)} requests"
+            )
+        kc = rec.kind_counts
+        events_ok = (
+            kc["submit"] == len(prompts)
+            and kc["queue_pop"] == len(prompts)
+            and kc["complete"] == len(prompts)
+            and kc["prefill"] == eng_f.n_prefills
+            and kc["chain_start"] == eng_f.n_chains
+            and kc["chain_end"] == eng_f.n_chains
+        )
+        if not events_ok:
+            problems.append(
+                f"flight arm: event counts do not reconcile with the "
+                f"engine counters: {dict(kc)} vs {eng_f.n_prefills} "
+                f"prefills / {eng_f.n_chains} chains"
+            )
+        recon = all(
+            abs(spans[r]["e2e_s"] - comp_f[r].latency_s) < 1e-5
+            and abs(spans[r]["ttft_s"] - comp_f[r].ttft_s) < 1e-5
+            for r in comp_f
+        ) if span_full else False
+        if span_full and not recon:
+            problems.append(
+                "flight arm: span timings diverge from Completion"
+            )
+
+        def hist_matches_sort(h, vals):
+            # same rank convention as LogHistogram.quantile; the bound
+            # is the histogram's own documented one-bucket error
+            ok = True
+            for q in (0.50, 0.95):
+                sv = sorted(vals)[max(1, math.ceil(q * len(vals))) - 1]
+                tol = h.rel_error_bound * max(sv, h.min_value) + 1e-9
+                ok = ok and abs(h.quantile(q) - sv) <= tol
+            return ok
+
+        hist_ok = hist_matches_sort(
+            rec.hist["e2e"], [c.latency_s for c in comp_f.values()]
+        ) and hist_matches_sort(
+            rec.hist["ttft"], [c.ttft_s for c in comp_f.values()]
+        )
+        if not hist_ok:
+            problems.append(
+                "flight arm: histogram p50/p95 outside one bucket of "
+                "the sort-based percentiles"
+            )
+        flight_fields = {
+            "flight_requests": len(prompts),
+            "flight_span_full": span_full,
+            "flight_events_consistent": events_ok,
+            "flight_hist_vs_sort": hist_ok,
+            "flight_host_fetches": count["n"],
+            **eng_f.stats("flight"),
+        }
 
     # ------------------------------------------------------------------
     # chaos arm (--chaos, ISSUE 9): one staggered stream exercising every
@@ -457,9 +598,20 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
         eng_ref.submit(Request(prompt=p1, max_new_tokens=16))
         ref = {c.request_id: c for c in eng_ref.run_until_idle()}
 
+        # the faulty engine carries a dump-path recorder: every injected
+        # fault must auto-dump a graft-flightlog/v1 snapshot whose
+        # trigger names the victim (the ISSUE 10 post-mortem contract)
+        from pytorch_distributed_training_tutorials_tpu.obs import (
+            FlightRecorder,
+            load_flightlog,
+        )
+
+        fd, dump_path = tempfile.mkstemp(suffix=".flightlog.jsonl")
+        os.close(fd)
         eng_x = ServeEngine(
             model, params, n_slots=2, tokens_per_launch=4,
             guard_nonfinite=True, chaos=ccfg,
+            flight=FlightRecorder(capacity=128, dump_path=dump_path),
         )
         count = {"n": 0}
 
@@ -516,7 +668,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
                 f"chaos arm: {chaos_fetches} host fetches > "
                 f"{chaos_budget} (chains + prefills + splices)"
             )
-        fstats = eng_x.fault_stats()
+        fstats = eng_x.stats("fault")
         for key, want in (
             ("nonfinite_quarantined", 1),
             ("deadline_expired", 1),
@@ -527,6 +679,29 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
                     f"fault_stats[{key!r}] = {fstats.get(key)}, "
                     f"expected {want}"
                 )
+        # the flight dump: one snapshot per fault-class event, and the
+        # nonfinite one must NAME the quarantined slot
+        try:
+            snaps = load_flightlog(dump_path)
+        except ValueError as e:
+            snaps = []
+            problems.append(f"chaos flight dump failed validation: {e}")
+        named_slot = any(
+            s.get("trigger", {}).get("fault_kind") == "nonfinite"
+            and s.get("trigger", {}).get("slot") == 0
+            for s in snaps
+            if s.get("trigger")
+        )
+        if len(snaps) < 2:  # nonfinite + deadline at minimum
+            problems.append(
+                f"chaos arm: {len(snaps)} flight dumps, expected >= 2 "
+                "(nonfinite quarantine + deadline expiry)"
+            )
+        if not named_slot:
+            problems.append(
+                "chaos arm: no flight dump names the quarantined slot"
+            )
+        os.unlink(dump_path)
 
         # mini training leg: skip-step guard on a poisoned batch
         reg = LinearRegressor(in_dim=4)
@@ -572,6 +747,8 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             "steps_skipped": steps_skipped,
             "chaos_token_exact": chaos_exact,
             "chaos_host_fetches": chaos_fetches,
+            "chaos_flight_dumps": len(snaps),
+            "chaos_flight_named_slot": named_slot,
         }
 
     receipt = make_receipt(
@@ -600,6 +777,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             "adapter_token_exact": adapter_exact,
             "adapter_host_fetches": fetches_mix,
             **astats,
+            **flight_fields,
             **fault_fields,
             "problems": problems,
             "ok": not problems,
@@ -639,6 +817,12 @@ def main(argv: list[str] | None = None) -> int:
         "deadline expiry, cancel, close/drain, and the training "
         "skip-step guard (ISSUE 9)",
     )
+    parser.add_argument(
+        "--flight", action="store_true",
+        help="also run the flight-recorder arm: full lifecycle spans, "
+        "histogram-vs-sort percentile parity, unchanged fetch budget "
+        "(ISSUE 10)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -658,7 +842,8 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
     receipt = selftest(args.json, spec_k=args.spec_k,
-                       adapters=args.adapters, chaos=args.chaos)
+                       adapters=args.adapters, chaos=args.chaos,
+                       flight=args.flight)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

@@ -191,6 +191,7 @@ class ServeEngine:
         default_deadline_s: float | None = None,
         guard_nonfinite: bool = False,
         chaos=None,
+        flight=None,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -292,6 +293,12 @@ class ServeEngine:
         self._deadline = default_deadline_s
         self._guard = bool(guard_nonfinite)
         self._chaos = chaos
+        # flight recorder (ISSUE 10): None = off. On, lifecycle events
+        # and spans are stamped at the SAME host boundaries the code
+        # below already touches — a clock read + a deque append, never a
+        # device fetch, so the fetch budget and the compiled programs are
+        # IDENTICAL either way (tests/test_serve.py pins both).
+        self._flight = flight
         self._inject_logits = chaos is not None and chaos.poisons_logits
         self._cancelled: set[int] = set()
         self.n_deadline_expired = 0
@@ -690,7 +697,15 @@ class ServeEngine:
         if self._adapters:
             self._bank.check_id(aid)
             request.adapter_gen = self._bank.generation(aid)
-        return self.scheduler.submit(request)
+        rid = self.scheduler.submit(request)
+        if self._flight is not None:
+            # stamped AFTER admission: rejected submissions never open a
+            # span (the caller got a synchronous exception instead)
+            self._flight.request_submitted(
+                rid, p_len=len(request.prompt),
+                max_new=request.max_new_tokens, adapter=aid,
+            )
+        return rid
 
     @property
     def active_slots(self) -> int:
@@ -717,16 +732,26 @@ class ServeEngine:
             # before serving it and this is a non-event for them)
             self.refresh_adapters()
         done: list[Completion] = list(self._sweep())
+        if self._flight is not None and done:
+            self._flight.sweep(len(done))
         for s in range(self.n_slots):
             if self._slots[s] is not None:
                 continue
             req = self.scheduler.pop()
             if req is None:
                 break
+            if self._flight is not None:
+                self._flight.request_popped(req.request_id)
             done.extend(self._refill(s, req))
         if self.active_slots:
+            if self._flight is not None:
+                # occupancy at dispatch = chain utilization sample
+                self._flight.chain_start(self.active_slots, self.n_slots)
+                gen_before = self.generated_tokens
             if self._chaos is not None:
-                chaos_lib.maybe_stall(self._chaos, self.n_chains)
+                chaos_lib.maybe_stall(
+                    self._chaos, self.n_chains, flight=self._flight
+                )
             if self._inject_logits:
                 # global decode-step base for the deterministic injector
                 # — a traced scalar, so faulty and clean chains are the
@@ -755,6 +780,11 @@ class ServeEngine:
                 else:
                     toks, oks = fetched, None
                 done.extend(self._distribute(toks, oks))
+            if self._flight is not None:
+                self._flight.chain_end(
+                    tokens=self.generated_tokens - gen_before,
+                    occupancy=self.active_slots,
+                )
         return done
 
     def _deadline_for(self, req: Request) -> float | None:
@@ -790,6 +820,10 @@ class ServeEngine:
                 if dl is not None and now - req.submitted_s > dl:
                     reason = "deadline"
                     self.n_deadline_expired += 1
+                    if self._flight is not None:
+                        self._flight.fault(
+                            "deadline", rid=req.request_id, slot=s
+                        )
             if reason is not None:
                 self._slots[s] = None
                 if act.remaining > 0:
@@ -886,6 +920,8 @@ class ServeEngine:
         dl = self._deadline_for(req)
         if dl is not None and time.perf_counter() - req.submitted_s > dl:
             self.n_deadline_expired += 1
+            if self._flight is not None:
+                self._flight.fault("deadline", rid=req.request_id)
             return [self._complete_unstarted(req, "deadline")]
         aid = int(getattr(req, "adapter", 0))
         if aid and not (
@@ -893,6 +929,10 @@ class ServeEngine:
             and self._bank.generation(aid) == req.adapter_gen
         ):
             self.adapter_rejected += 1
+            if self._flight is not None:
+                self._flight.fault(
+                    "adapter_evicted", rid=req.request_id, adapter=aid
+                )
             return [self._complete_unstarted(req, "adapter_evicted")]
         if aid:
             self.adapter_requests += 1
@@ -961,6 +1001,10 @@ class ServeEngine:
             if segment is not None:
                 self.prefix.release(segment)
             self.n_prefill_errors += 1
+            if self._flight is not None:
+                self._flight.fault(
+                    "prefill_error", rid=req.request_id, slot=slot
+                )
             self._state["remaining"] = self._park(
                 self._state["remaining"], slot
             )
@@ -968,6 +1012,14 @@ class ServeEngine:
         self.generated_tokens += 1
         act = _Active(req, first)
         act.ttft_s = time.perf_counter() - req.submitted_s
+        if self._flight is not None:
+            # stamped after the scalar fetch: the first token exists, so
+            # the span's prefill_t is an honest first-token time
+            self._flight.request_prefilled(
+                req.request_id, slot,
+                kind="splice" if segment is not None else "prefill",
+                cached_len=hit[0] if segment is not None else 0,
+            )
         if segment is not None:
             act.segment = segment
         if req.max_new_tokens == 1 or first == req.eos_token:
@@ -1025,6 +1077,11 @@ class ServeEngine:
                 if oks is not None and not oks[s, t]:
                     reason = "nonfinite"
                     self.nonfinite_quarantined += 1
+                    if self._flight is not None:
+                        self._flight.fault(
+                            "nonfinite", rid=act.request.request_id,
+                            slot=s, chain_step=t,
+                        )
                     break
                 tok = int(tok_)
                 act.tokens.append(tok)
@@ -1064,6 +1121,11 @@ class ServeEngine:
                 if oks is not None and not oks[s, t]:
                     reason = "nonfinite"
                     self.nonfinite_quarantined += 1
+                    if self._flight is not None:
+                        self._flight.fault(
+                            "nonfinite", rid=act.request.request_id,
+                            slot=s, chain_step=t,
+                        )
                     break
                 n = int(counts[s, t])
                 if n == 0:  # slot went inactive device-side
@@ -1095,13 +1157,19 @@ class ServeEngine:
         """A zero-token completion for a request bounced at a boundary
         before any device work (cancelled / deadline / adapter_evicted /
         prefill error): zero fetches, zero tokens, synchronous."""
-        return Completion(
+        comp = Completion(
             request_id=req.request_id,
             prompt=[int(t) for t in req.prompt],
             tokens=[],
             finish_reason=reason,
             latency_s=time.perf_counter() - req.submitted_s,
         )
+        if self._flight is not None:
+            self._flight.request_completed(
+                req.request_id, reason, tokens=0,
+                latency_s=comp.latency_s,
+            )
+        return comp
 
     def _complete(self, act: _Active, reason: str) -> Completion:
         if act.segment is not None:
@@ -1109,7 +1177,7 @@ class ServeEngine:
             # unpin it (it stays resident + hot for the next hit)
             self.prefix.release(act.segment)
             act.segment = None
-        return Completion(
+        comp = Completion(
             request_id=act.request.request_id,
             prompt=[int(t) for t in act.request.prompt],
             tokens=act.tokens,
@@ -1117,6 +1185,15 @@ class ServeEngine:
             latency_s=time.perf_counter() - act.request.submitted_s,
             ttft_s=act.ttft_s,
         )
+        if self._flight is not None:
+            # the span records the Completion's OWN numbers, so the
+            # histogram percentiles are sample-identical to sorting the
+            # completion list (only the bucket rounding differs)
+            self._flight.request_completed(
+                comp.request_id, reason, tokens=len(comp.tokens),
+                latency_s=comp.latency_s, ttft_s=comp.ttft_s,
+            )
+        return comp
 
     def prefix_stats(self) -> dict[str, int | float]:
         """Prefix-cache counters for the serving receipt: index stats
@@ -1189,6 +1266,10 @@ class ServeEngine:
             raise ValueError("engine has no adapter bank")
         self.params = self._bank.merge_params(self._base_params)
         self._merged_version = self._bank.version
+        if self._flight is not None:
+            self._flight.record(
+                "adapter_refresh", version=self._merged_version
+            )
 
     def adapter_stats(self) -> dict[str, int | float]:
         """Multi-tenancy counters for the serving receipt (same pattern
@@ -1207,6 +1288,46 @@ class ServeEngine:
             "adapter_rejected": self.adapter_rejected,
             "adapter_bytes": reg.used_bytes,
         }
+
+    def flight_stats(self) -> dict[str, int | float]:
+        """Flight-recorder aggregate for the serving receipt: event /
+        span / dump counters + the streaming-histogram percentiles
+        (``ttft_p95_s``-style keys). ``{"flight": 0}`` when the recorder
+        is off — regress.py fingerprints the flag so instrumented and
+        bare rounds never gate each other. Host bookkeeping only."""
+        if self._flight is None:
+            return {"flight": 0}
+        return self._flight.summary()
+
+    _STATS_PARTS = ("prefix", "spec", "adapters", "fault", "flight")
+
+    def stats(self, *parts: str) -> dict[str, int | float]:
+        """ONE aggregate over every per-subsystem stats dict — the
+        receipt/selftest call sites used to re-assemble these by hand.
+        ``stats()`` returns everything; ``stats("spec", "fault")``
+        selects subsystems (multi-engine callers merge stats from
+        DIFFERENT engines, and an unfiltered merge would clobber e.g.
+        one engine's ``prefix_cache: 1`` with another's ``0``). Key sets
+        are disjoint across subsystems, so the full merge is lossless."""
+        chosen = parts or self._STATS_PARTS
+        unknown = set(chosen) - set(self._STATS_PARTS)
+        if unknown:
+            raise ValueError(
+                f"unknown stats parts {sorted(unknown)}; "
+                f"known: {list(self._STATS_PARTS)}"
+            )
+        fns = {
+            "prefix": self.prefix_stats,
+            "spec": self.spec_stats,
+            "adapters": self.adapter_stats,
+            "fault": self.fault_stats,
+            "flight": self.flight_stats,
+        }
+        out: dict[str, int | float] = {}
+        for part in self._STATS_PARTS:
+            if part in chosen:
+                out.update(fns[part]())
+        return out
 
 
 def _seed_history(state, tokens, p_len, slot, first):

@@ -567,6 +567,7 @@ class Trainer:
         rollback_spike_factor: float | None = None,
         rollback_patience: int = 2,
         rollback_ema: float = 0.9,
+        flight=None,
     ):
         self.model = model
         self.loader = train_loader
@@ -691,8 +692,15 @@ class Trainer:
         # logger honors defer_host_fetch at epoch boundaries. ``quiet``
         # silences console output (bench runs) without losing events.
         self.metrics = metrics if metrics is not None else MetricsLogger(
-            quiet=quiet, defer_host_fetch=defer_host_fetch
+            quiet=quiet, defer_host_fetch=defer_host_fetch, flight=flight
         )
+        # flight recorder (ISSUE 10): skip-step observations reach it
+        # through the MetricsLogger drain above (the "skipped" extra
+        # already rides the batched fetch — no new per-step sync);
+        # rollbacks stamp directly in _do_rollback (host-side already).
+        self._flight = flight
+        if flight is not None and self.metrics.flight is None:
+            self.metrics.flight = flight
         # host-side hook points, called OUTSIDE traced code (graftcheck-
         # clean by construction): on_step(step, loss_device_scalar) after
         # each dispatched step/chunk, on_epoch(metrics_dict) after each
@@ -1023,6 +1031,12 @@ class Trainer:
         self.rollbacks += 1
         self._rb_strikes = 0
         self._rb_ema = None
+        if self._flight is not None:
+            # host-side already (the monitor observes fetched floats) —
+            # stamping adds no sync; auto-dumps when dump_path is set
+            self._flight.rollback(
+                step=self._monitor_steps, loss=loss_value
+            )
         self.metrics.say(
             f"  rollback #{self.rollbacks}: loss {loss_value:.4g} spiked "
             f">{self._rb_factor:g}x EMA for {self._rb_patience} obs — "

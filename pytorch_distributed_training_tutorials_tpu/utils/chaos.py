@@ -176,11 +176,17 @@ def maybe_fail_prefill(cfg: ChaosConfig, request_id: int) -> None:
         )
 
 
-def maybe_stall(cfg: ChaosConfig, chain_index: int) -> None:
+def maybe_stall(cfg: ChaosConfig, chain_index: int, flight=None) -> None:
     """Sleep ``stall_s`` before the configured chain index — wall time
     passes (deadlines expire) with zero device-side effect, mimicking a
-    launch stall."""
+    launch stall. When a :class:`..obs.flight.FlightRecorder` rides
+    along it stamps a ``stall`` event first, so the post-mortem timeline
+    shows the gap as INJECTED rather than as a mystery launch stall."""
     if cfg.stalls and chain_index == cfg.stall_chain:
+        if flight is not None:
+            flight.record(
+                "stall", chain=chain_index, stall_s=cfg.stall_s
+            )
         time.sleep(cfg.stall_s)
 
 

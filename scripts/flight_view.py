@@ -1,0 +1,144 @@
+"""Render a graft-flightlog/v1 dump as a human-readable timeline.
+
+The flight recorder (``obs/flight.py``) answers "what was the engine
+doing when slot 3 went nonfinite" — but its dumps are JSONL snapshots
+meant for machines. This script is the human half of the loop: point it
+at a ``--flight-log`` file (examples/serve_llm_int8.py --server) or at
+the auto-dumps a chaos run appended, and it prints, per snapshot, a
+monotonic event timeline (relative timestamps, one line per event, the
+trigger highlighted), the live/completed request spans with their
+queue-wait / TTFT / decode splits, and the histogram summaries.
+
+Deliberately stdlib-only and jax-free: a post-mortem viewer must run on
+a laptop over a dump scp'd off the serving host, with no accelerator
+stack installed. (graftcheck's import-purity sweep covers scripts/ — a
+jax-free file is trivially pure.)
+
+Usage:
+    python scripts/flight_view.py FLIGHT_rXX.jsonl
+    python scripts/flight_view.py FLIGHT_rXX.jsonl --events 200
+    python scripts/flight_view.py FLIGHT_rXX.jsonl --snapshot -1
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pytorch_distributed_training_tutorials_tpu.obs.flight import (  # noqa: E402
+    load_flightlog,
+)
+from pytorch_distributed_training_tutorials_tpu.obs.histogram import (  # noqa: E402
+    LogHistogram,
+)
+
+
+def _fmt_event(ev: dict, trigger: dict | None) -> str:
+    t = ev.get("t", 0.0)
+    kind = ev.get("kind", "?")
+    rest = ", ".join(
+        f"{k}={v}" for k, v in ev.items() if k not in ("t", "kind")
+    )
+    mark = " <-- trigger" if trigger is not None and ev == trigger else ""
+    return f"  {t:>12.6f}s  {kind:<16s} {rest}{mark}"
+
+
+def _fmt_span(span: dict) -> str:
+    rid = span.get("rid", "?")
+    parts = [f"  request {rid}:"]
+    submit = span.get("submit_t")
+    if submit is not None:
+        if "queue_pop_t" in span:
+            parts.append(f"queue {span['queue_pop_t'] - submit:.4f}s")
+        if "ttft_s" in span:
+            parts.append(f"ttft {span['ttft_s']:.4f}s")
+        elif "prefill_t" in span:
+            parts.append(f"prefill at +{span['prefill_t'] - submit:.4f}s")
+    if "e2e_s" in span:
+        parts.append(f"e2e {span['e2e_s']:.4f}s")
+    if "tokens" in span:
+        parts.append(f"{span['tokens']} tokens")
+    if "decode_tok_per_s" in span:
+        parts.append(f"{span['decode_tok_per_s']} tok/s")
+    if "slot" in span:
+        parts.append(f"slot {span['slot']}")
+    if "path" in span:
+        parts.append(span["path"])
+    if "finish_reason" in span:
+        parts.append(f"-> {span['finish_reason']}")
+    return " ".join(parts)
+
+
+def render(snap: dict, index: int, max_events: int) -> None:
+    print(
+        f"=== snapshot {index}: reason={snap['reason']!r} "
+        f"t={snap['t']:.3f}s  ({snap['n_events']} events total, "
+        f"{snap.get('dropped', 0)} dropped from the ring) ==="
+    )
+    counts = snap.get("counts", {})
+    if counts:
+        line = ", ".join(
+            f"{k}: {v}" for k, v in sorted(counts.items())
+        )
+        print(f"event counts: {line}")
+    trigger = snap.get("trigger")
+    print(f"\nevents (last {min(max_events, len(snap['events']))}):")
+    for ev in snap["events"][-max_events:]:
+        print(_fmt_event(ev, trigger))
+    if snap.get("live_spans"):
+        print("\nlive requests at dump time:")
+        for span in snap["live_spans"]:
+            print(_fmt_span(span))
+    if snap.get("done_spans"):
+        print("\ncompleted requests (most recent last):")
+        for span in snap["done_spans"]:
+            print(_fmt_span(span))
+    hists = snap.get("histograms", {})
+    if hists:
+        print("\nlatency histograms:")
+        for name, state in sorted(hists.items()):
+            h = LogHistogram.from_dict(state)
+            if h.n == 0:
+                continue
+            print(
+                f"  {name:<12s} n={h.n:<6d} mean={h.mean:.4f} "
+                f"p50={h.quantile(0.5):.4f} p95={h.quantile(0.95):.4f} "
+                f"p99={h.quantile(0.99):.4f}"
+            )
+    print()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render graft-flightlog/v1 dumps as timelines"
+    )
+    ap.add_argument("path", help="JSONL flight log (one snapshot/line)")
+    ap.add_argument(
+        "--events", type=int, default=64,
+        help="max events to print per snapshot (default 64)",
+    )
+    ap.add_argument(
+        "--snapshot", type=int, default=None,
+        help="render only this snapshot index (negative = from the "
+        "end); default renders all",
+    )
+    args = ap.parse_args(argv)
+    snaps = load_flightlog(args.path)
+    if not snaps:
+        print(f"{args.path}: no snapshots")
+        return 1
+    if args.snapshot is not None:
+        start = args.snapshot % len(snaps)
+        snaps = [snaps[args.snapshot]]
+    else:
+        start = 0
+    for i, snap in enumerate(snaps):
+        render(snap, start + i, args.events)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

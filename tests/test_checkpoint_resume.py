@@ -194,9 +194,11 @@ def test_loss_spike_rollback_restores_and_continues(tmp_path):
     latest checkpoint and training CONTINUES — epoch position preserved
     (skip the bad region, don't replay it), exactly one rollback, and
     the run finishes with a finite loss."""
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import FlightRecorder
     from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
 
     ck = str(tmp_path / "ck")
+    rec = FlightRecorder(capacity=64)
     t = Trainer(
         MLP(features=(32, 4)),
         ShardedLoader(make_cls_dataset(), 8, create_mesh({"data": 8}),
@@ -205,6 +207,7 @@ def test_loss_spike_rollback_restores_and_continues(tmp_path):
         rollback_spike_factor=10.0, rollback_patience=2,
         chaos=ChaosConfig(spike_loss_step=6, spike_loss_len=3,
                           spike_loss_factor=1e6),
+        flight=rec,
     )
     t.train(1)  # 4 steps/epoch: healthy monitor steps 1-4 seed the EMA
     t.save(ck)
@@ -212,6 +215,10 @@ def test_loss_spike_rollback_restores_and_continues(tmp_path):
     assert t.rollbacks == 1
     assert t.epoch == 3  # continued to the end, no epoch replay
     assert np.isfinite(t.last_epoch_metrics["loss"])
+    # ISSUE 10: the rollback stamped a fault-class flight event
+    assert rec.kind_counts["rollback"] == 1 and rec.n_faults == 1
+    (ev,) = [e for e in rec.events if e["kind"] == "rollback"]
+    assert ev["step"] == 7 and ev["loss"] > 1e3
 
 
 def test_rollback_without_checkpoint_raises():

@@ -1,0 +1,310 @@
+"""obs/flight.py + obs/histogram.py: the serving flight recorder.
+
+Everything here is jax-free by contract (the no-jax subprocess pin in
+tests/test_prefix.py covers both modules), so these tests run as pure
+host code: histogram quantiles stay within the documented one-bucket
+bound against exact sorts on adversarial distributions, sharded
+recording merges to exactly the whole-sample state, the event ring
+wraps without corrupting live spans, and fault-class events auto-dump
+``graft-flightlog/v1`` snapshots that name their trigger. The
+engine-integration half (fetch budget with the recorder ON, off-path
+byte-identity) lives in tests/test_serve.py where the engine fixtures
+are.
+"""
+
+import json
+import math
+import random
+import sys
+
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.obs.flight import (
+    EVENT_KINDS,
+    FLIGHT_SCHEMA,
+    FlightRecorder,
+    load_flightlog,
+    validate_flightlog,
+)
+from pytorch_distributed_training_tutorials_tpu.obs.histogram import LogHistogram
+
+
+# ---------------------------------------------------------------- histograms
+
+def _exact_quantile(sorted_vals, q):
+    """The rank convention LogHistogram.quantile uses: ceil(q * n)."""
+    return sorted_vals[max(1, math.ceil(q * len(sorted_vals))) - 1]
+
+
+def _assert_quantiles_within_bound(h, vals):
+    sv = sorted(vals)
+    for q in (0.05, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0):
+        exact = _exact_quantile(sv, q)
+        tol = h.rel_error_bound * max(exact, h.min_value) + 1e-9
+        assert abs(h.quantile(q) - exact) <= tol, (
+            f"q={q}: {h.quantile(q)} vs exact {exact} (tol {tol})"
+        )
+
+
+@pytest.mark.parametrize("dist", [
+    "lognormal", "bimodal", "constant", "heavy_tail", "near_edges",
+])
+def test_histogram_quantiles_within_one_bucket_of_sort(dist):
+    """The documented guarantee on distributions chosen to stress the
+    binning: heavy tails (clamp path), point masses (every sample in one
+    bucket), bimodal gaps (empty bucket runs mid-walk), and values
+    sitting exactly on bucket edges (the (lo, hi] pushdown)."""
+    rng = random.Random(42)
+    if dist == "lognormal":
+        vals = [rng.lognormvariate(-2.0, 2.0) for _ in range(3000)]
+    elif dist == "bimodal":
+        vals = [rng.gauss(0.001, 0.0001) for _ in range(1500)] + \
+               [rng.gauss(100.0, 5.0) for _ in range(1500)]
+        vals = [abs(v) + 1e-6 for v in vals]
+    elif dist == "constant":
+        vals = [0.25] * 1000
+    elif dist == "heavy_tail":
+        # paretovariate(0.5) throws samples far past max_value
+        vals = [rng.paretovariate(0.5) for _ in range(3000)]
+    else:  # near_edges: exact bucket-edge values
+        h0 = LogHistogram()
+        vals = [
+            h0.min_value * 2.0 ** (i / h0.bins_per_octave)
+            for i in range(0, 60, 3)
+        ] * 20
+    h = LogHistogram()
+    for v in vals:
+        h.record(v)
+    assert h.n == len(vals)
+    if dist == "heavy_tail":
+        # clamped samples keep the true max; only quantiles that land in
+        # the final bucket saturate at max_seen — check p50 honestly and
+        # the max exactly
+        sv = sorted(vals)
+        exact = _exact_quantile(sv, 0.5)
+        assert abs(h.quantile(0.5) - exact) <= h.rel_error_bound * exact
+        assert h.quantile(1.0) <= h.max_seen == max(vals)
+    else:
+        _assert_quantiles_within_bound(h, vals)
+
+
+def test_histogram_zero_and_negative_clamp_to_underflow_bucket():
+    h = LogHistogram()
+    for v in (0.0, -1.0, 1e-9, h.min_value):
+        h.record(v)
+    assert h.counts[0] == 4 and h.n == 4
+    # the estimate clamps to the observed max (all samples <= min_value)
+    assert h.quantile(0.5) == h.max_seen == h.min_value
+    all_zero = LogHistogram()
+    all_zero.record(0.0)
+    assert all_zero.quantile(0.95) == 0.0
+
+
+def test_histogram_nan_dropped_empty_returns_zero():
+    h = LogHistogram()
+    h.record(float("nan"))
+    assert h.n == 0 and h.quantile(0.95) == 0.0 and h.mean == 0.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_sharded_merge_equals_whole():
+    rng = random.Random(3)
+    vals = [rng.expovariate(10.0) for _ in range(2000)]
+    whole = LogHistogram()
+    shards = [LogHistogram() for _ in range(4)]
+    for i, v in enumerate(vals):
+        whole.record(v)
+        shards[i % 4].record(v)
+    merged = shards[0]
+    for s in shards[1:]:
+        merged.merge(s)
+    assert merged.counts == whole.counts
+    assert merged.n == whole.n
+    # the float sum reassociates across shards; counts are the exact part
+    assert math.isclose(merged.total, whole.total, rel_tol=1e-12)
+    assert merged.min_seen == whole.min_seen
+    assert merged.max_seen == whole.max_seen
+    for q in (0.5, 0.95, 0.99):
+        assert merged.quantile(q) == whole.quantile(q)
+
+
+def test_histogram_merge_rejects_different_geometry():
+    with pytest.raises(ValueError):
+        LogHistogram().merge(LogHistogram(bins_per_octave=4))
+
+
+def test_histogram_json_round_trip():
+    rng = random.Random(5)
+    h = LogHistogram()
+    for _ in range(500):
+        h.record(rng.lognormvariate(-3.0, 1.0))
+    rt = LogHistogram.from_dict(json.loads(json.dumps(h.to_dict())))
+    assert rt.counts == h.counts and rt.n == h.n
+    for q in (0.5, 0.95, 0.99):
+        assert rt.quantile(q) == h.quantile(q)
+    empty_rt = LogHistogram.from_dict(
+        json.loads(json.dumps(LogHistogram().to_dict()))
+    )
+    assert empty_rt.n == 0 and empty_rt.quantile(0.95) == 0.0
+
+
+def test_histogram_bad_construction_raises():
+    with pytest.raises(ValueError):
+        LogHistogram(min_value=0.0)
+    with pytest.raises(ValueError):
+        LogHistogram(min_value=1.0, max_value=0.5)
+    with pytest.raises(ValueError):
+        LogHistogram(bins_per_octave=0)
+
+
+def test_histogram_summary_keys_and_units():
+    h = LogHistogram()
+    h.record(0.5)
+    s = h.summary(prefix="ttft_", unit="s")
+    assert s["ttft_count"] == 1
+    assert set(s) == {
+        "ttft_count", "ttft_mean_s", "ttft_min_s", "ttft_max_s",
+        "ttft_p50_s", "ttft_p95_s", "ttft_p99_s",
+    }
+    assert "chain_util_p95" in LogHistogram().summary(prefix="chain_util_")
+
+
+# ------------------------------------------------------------ flight recorder
+
+def test_span_lifecycle_full_record():
+    rec = FlightRecorder(capacity=64)
+    rec.request_submitted(7, p_len=12, max_new=8, adapter=2)
+    rec.request_popped(7)
+    rec.request_prefilled(7, slot=3, kind="splice", cached_len=8)
+    rec.chain_start(1, 4)
+    rec.chain_end(tokens=8, occupancy=1)
+    rec.request_completed(7, "length", tokens=8, latency_s=0.5,
+                          ttft_s=0.1)
+    rec.sweep(1)
+    assert not rec.spans  # closed span left the live dict
+    (span,) = rec.done_spans
+    assert span["rid"] == 7 and span["finish_reason"] == "length"
+    assert span["slot"] == 3 and span["path"] == "splice"
+    assert span["cached_len"] == 8 and span["adapter"] == 2
+    # engine-provided numbers recorded verbatim, decode rate derived
+    assert span["e2e_s"] == 0.5 and span["ttft_s"] == 0.1
+    assert span["decode_tok_per_s"] == round(7 / 0.4, 3)
+    assert rec.hist["e2e"].n == 1 and rec.hist["ttft"].n == 1
+    assert rec.hist["queue_wait"].n == 1
+    assert rec.hist["chain_util"].n == 1
+    kc = rec.kind_counts
+    assert kc["submit"] == kc["queue_pop"] == kc["splice"] == 1
+    assert kc["chain_start"] == kc["chain_end"] == kc["sweep"] == 1
+    assert kc["complete"] == 1
+
+
+def test_ring_wraparound_keeps_live_spans_coherent():
+    """The ring is bounded; spans are NOT in the ring. Flood the ring
+    past capacity while a request is mid-flight: its span must survive
+    intact and still close into a full record."""
+    rec = FlightRecorder(capacity=8)
+    rec.request_submitted(1, p_len=4, max_new=4)
+    rec.request_popped(1)
+    rec.request_prefilled(1, slot=0)
+    for i in range(50):  # 100 events >> capacity 8
+        rec.chain_start(1, 2)
+        rec.chain_end(tokens=1, occupancy=1)
+    assert len(rec.events) == 8
+    assert rec.dropped == rec.n_events - 8 > 0
+    # the submit/pop/prefill events are long gone from the ring...
+    assert all(e["kind"] in ("chain_start", "chain_end")
+               for e in rec.events)
+    # ...but the live span is untouched and closes normally
+    span = rec.spans[1]
+    assert span["slot"] == 0 and "prefill_t" in span
+    rec.request_completed(1, "length", tokens=4)
+    (done,) = rec.done_spans
+    assert done["finish_reason"] == "length" and "e2e_s" in done
+
+
+def test_unknown_event_kind_rejected():
+    rec = FlightRecorder()
+    with pytest.raises(ValueError, match="unknown flight event kind"):
+        rec.record("telemetry")
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_fault_auto_dump_schema_and_trigger(tmp_path):
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(capacity=32, dump_path=path, dump_events=16)
+    rec.request_submitted(0, p_len=4, max_new=8)
+    rec.request_popped(0)
+    rec.request_prefilled(0, slot=1)
+    rec.fault("nonfinite", rid=0, slot=1, chain_step=3)
+    rec.step_skipped(step=12)  # trainer fault class auto-dumps too
+    rec.request_completed(0, "nonfinite", tokens=3)
+    snaps = load_flightlog(path)  # load validates every line
+    assert len(snaps) == 2 and rec.n_dumps == 2 and rec.n_faults == 2
+    nf, sk = snaps
+    assert nf["schema"] == FLIGHT_SCHEMA and nf["reason"] == "fault"
+    assert nf["trigger"]["fault_kind"] == "nonfinite"
+    assert nf["trigger"]["slot"] == 1 and nf["trigger"]["rid"] == 0
+    # the dump carries the request's live span at fault time
+    assert any(s["rid"] == 0 and s["slot"] == 1
+               for s in nf["live_spans"])
+    assert {e["kind"] for e in nf["events"]} <= EVENT_KINDS
+    assert sk["reason"] == "step_skipped"
+    assert sk["trigger"]["step"] == 12
+    # explicit end-of-run dump appends a third line
+    rec.dump(reason="end_of_stream")
+    assert len(load_flightlog(path)) == 3
+
+
+def test_validate_flightlog_rejects_malformed():
+    with pytest.raises(ValueError, match="schema mismatch"):
+        validate_flightlog({"schema": "graft-receipt/v1"})
+    with pytest.raises(ValueError, match="missing key"):
+        validate_flightlog({"schema": FLIGHT_SCHEMA, "reason": "x"})
+    snap = FlightRecorder().snapshot()
+    validate_flightlog(snap)  # a fresh snapshot is well-formed
+    snap["events"] = [{"kind": "not-a-kind"}]
+    with pytest.raises(ValueError, match="unknown kind"):
+        validate_flightlog(snap)
+
+
+def test_dump_without_path_returns_snapshot_only(tmp_path):
+    rec = FlightRecorder()
+    rec.chain_start(1, 2)
+    snap = rec.dump(reason="manual")
+    validate_flightlog(snap)
+    assert rec.n_dumps == 1  # counted, nothing written anywhere
+
+
+def test_summary_flat_and_reset(tmp_path):
+    rec = FlightRecorder(capacity=16)
+    rec.request_submitted(0, p_len=2, max_new=2)
+    rec.request_popped(0)
+    rec.request_prefilled(0, slot=0)
+    rec.request_completed(0, "length", tokens=2, latency_s=0.2,
+                          ttft_s=0.05)
+    rec.fault("deadline", rid=1)
+    s = rec.summary()
+    assert s["flight"] == 1 and s["flight_spans_done"] == 1
+    assert s["flight_faults"] == 1 and s["e2e_count"] == 1
+    assert 0 < s["ttft_p95_s"] and 0 < s["e2e_p50_s"]
+    assert all(isinstance(v, (int, float)) for v in s.values())
+    rec.reset()
+    s2 = rec.summary()
+    assert s2["flight_events"] == 0 and s2["flight_spans_done"] == 0
+    assert s2["e2e_count"] == 0 and not rec.spans and not rec.events
+
+
+def test_completion_without_span_still_counts():
+    """A request completed with no prior submit (e.g. recorder attached
+    mid-stream) records the engine-provided latency and never crashes."""
+    rec = FlightRecorder()
+    rec.request_completed(99, "cancelled", tokens=0, latency_s=0.3)
+    (span,) = rec.done_spans
+    assert span["rid"] == 99 and span["e2e_s"] == 0.3
+    assert rec.hist["e2e"].n == 1 and rec.hist["ttft"].n == 0
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

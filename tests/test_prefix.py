@@ -197,6 +197,10 @@ def test_prefix_module_imports_no_jax():
         # adapters package itself share the host-only contract (ISSUE 8)
         "import pytorch_distributed_training_tutorials_tpu.adapters.registry\n"
         "import pytorch_distributed_training_tutorials_tpu.adapters\n"
+        # the flight recorder + histograms (ISSUE 10) are post-mortem
+        # tooling that must run on jax-less laptops over scp'd dumps
+        "import pytorch_distributed_training_tutorials_tpu.obs.flight\n"
+        "import pytorch_distributed_training_tutorials_tpu.obs.histogram\n"
         "assert 'jax' not in sys.modules, 'prefix index must not import jax'\n"
     )
     env = {k: v for k, v in os.environ.items() if k != "PYTHONSTARTUP"}

@@ -116,6 +116,44 @@ def test_bench_value_gated_only_when_unit_is_rate(tmp_path):
     assert regress.main([str(tmp_path)]) == 0  # ACC pair alone gates nothing
 
 
+def test_latency_p95_gated_lower_is_better(tmp_path, capsys):
+    """ISSUE 10: p95 latency tails are gated with the direction flipped —
+    the latest round must stay within (1 + tolerance) x the LOWEST
+    earlier p95; an improving (falling) tail never trips."""
+    _write(tmp_path, "SERVING_r01.json",
+           {**SERVING_CFG, "decode_tok_per_s": 100.0,
+            "server_p95_latency_s": 2.0, "server_ttft_p95_s": 0.5})
+    _write(tmp_path, "SERVING_r02.json",
+           {**SERVING_CFG, "decode_tok_per_s": 100.0,
+            "server_p95_latency_s": 1.5, "server_ttft_p95_s": 0.4})
+    assert regress.main([str(tmp_path)]) == 0  # tails fell: fine
+    _write(tmp_path, "SERVING_r03.json",
+           {**SERVING_CFG, "decode_tok_per_s": 100.0,
+            "server_p95_latency_s": 1.9, "server_ttft_p95_s": 0.4})
+    # 1.9 > best 1.5 * 1.05 -> the p95 regression trips even though
+    # throughput held flat
+    assert regress.main([str(tmp_path)]) == 1
+    assert "REGRESSION serving.server_p95_latency_s" in capsys.readouterr().out
+    assert regress.main([str(tmp_path), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    flagged = {r["metric"]: r for r in report["regressions"]}
+    assert set(flagged) == {"server_p95_latency_s"}
+    assert flagged["server_p95_latency_s"]["direction"] == "lower"
+    assert regress.main([str(tmp_path), "--tolerance", "0.30"]) == 0
+
+
+def test_flight_flag_splits_fingerprint(tmp_path):
+    """A recorder-instrumented round and a bare round are different
+    experiments — the "flight" config field keeps them from gating each
+    other (an instrumented round with a slower tok/s must not fail
+    against bare history, and vice versa)."""
+    _write(tmp_path, "SERVING_r01.json",
+           {**SERVING_CFG, "decode_tok_per_s": 100.0})
+    _write(tmp_path, "SERVING_r02.json",
+           {**SERVING_CFG, "flight": 1, "decode_tok_per_s": 80.0})
+    assert regress.main([str(tmp_path)]) == 0
+
+
 def test_bad_tolerance_is_usage_error(tmp_path):
     assert regress.main([str(tmp_path), "--tolerance", "1.5"]) == 2
 

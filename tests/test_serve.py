@@ -1386,6 +1386,156 @@ def test_robustness_validation(model_params):
     assert engine.idle
 
 
+# ---------------------------------------------- flight recorder (ISSUE 10)
+
+def _flight_engine(model, params, **kw):
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=256, **{
+        k: kw.pop(k) for k in ("dump_path",) if k in kw
+    })
+    return rec, ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=4, flight=rec, **kw
+    )
+
+
+def test_flight_records_full_request_lifecycle(model_params):
+    """Every completed request on a recorder-on engine gets a FULL span
+    (submit -> queue_pop -> prefill -> complete), the event counts
+    reconcile with the engine's own counters, and the recorded
+    latency/TTFT are the engine's Completion numbers verbatim — so the
+    histogram percentiles are sample-identical to sorting the list."""
+    model, params = model_params
+    rec, engine = _flight_engine(model, params)
+    prompts = [_prompt(5000 + i, 4 + 2 * i) for i in range(4)]
+    for p in prompts:
+        engine.submit(Request(prompt=p, max_new_tokens=8))
+    completions = {c.request_id: c for c in engine.run_until_idle()}
+    assert len(rec.done_spans) == len(prompts) and not rec.spans
+    for span in rec.done_spans:
+        assert {"submit_t", "queue_pop_t", "prefill_t", "complete_t",
+                "finish_reason", "slot"} <= set(span)
+        comp = completions[span["rid"]]
+        assert span["e2e_s"] == pytest.approx(comp.latency_s, abs=1e-5)
+        assert span["ttft_s"] == pytest.approx(comp.ttft_s, abs=1e-5)
+        assert span["tokens"] == len(comp.tokens)
+    kc = rec.kind_counts
+    assert kc["submit"] == kc["queue_pop"] == kc["complete"] == 4
+    assert kc["prefill"] == engine.n_prefills
+    assert kc["chain_start"] == kc["chain_end"] == engine.n_chains
+    assert rec.hist["e2e"].n == rec.hist["ttft"].n == 4
+    assert rec.hist["chain_util"].n == engine.n_chains
+    # the receipt surface rides the unified stats() aggregate
+    stats = engine.stats()
+    assert stats["flight"] == 1 and stats["flight_spans_done"] == 4
+    assert stats["e2e_count"] == 4 and stats["ttft_p95_s"] > 0
+    assert engine.flight_stats() == rec.summary()
+
+
+def test_flight_fetch_budget_unchanged(model_params, monkeypatch):
+    """Stamping events is host bookkeeping: with the recorder ON the
+    monkeypatched jax.device_get count stays EXACTLY chains + prefills —
+    the recorder never buys observability with a sync."""
+    model, params = model_params
+    rec, engine = _flight_engine(model, params)
+    prompts = [_prompt(5100 + i, 5) for i in range(3)]  # before the spy
+    calls = {"n": 0}
+    real_get = jax.device_get
+    monkeypatch.setattr(
+        jax, "device_get",
+        lambda x: (calls.__setitem__("n", calls["n"] + 1), real_get(x))[1],
+    )
+    for p in prompts:
+        engine.submit(Request(prompt=p, max_new_tokens=10))
+    assert len(engine.run_until_idle()) == 3
+    assert calls["n"] == engine.n_chains + engine.n_prefills
+    assert rec.n_events > 0  # the recorder was live the whole time
+
+
+def test_flight_off_engine_unchanged(model_params):
+    """Recorder OFF (the default) keeps the slot-state tree byte-
+    identical and compiles the same number of programs; recorder ON
+    changes neither — only host-side bookkeeping differs, so the token
+    streams match bitwise."""
+    model, params = model_params
+    base_keys = {"cache", "last_tok", "keys", "remaining"}
+
+    def run(flight=None):
+        engine = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=4, flight=flight,
+        )
+        for i in range(3):
+            engine.submit(
+                Request(prompt=_prompt(5200 + i, 6), max_new_tokens=8)
+            )
+        toks = [c.tokens for c in engine.run_until_idle()]
+        return engine, toks
+
+    off_eng, off_toks = run()
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import FlightRecorder
+
+    on_eng, on_toks = run(FlightRecorder(capacity=64))
+    assert set(off_eng._state) == set(on_eng._state) == base_keys
+    assert on_toks == off_toks
+    assert (off_eng._chain._cache_size()
+            == on_eng._chain._cache_size())
+    assert (off_eng._prefill._cache_size()
+            == on_eng._prefill._cache_size())
+    assert off_eng.flight_stats() == {"flight": 0}
+
+
+def test_flight_chaos_fault_dump_names_slot(model_params, tmp_path):
+    """A quarantined NaN slot auto-dumps one graft-flightlog/v1 snapshot
+    whose trigger names the (slot, chain step) — the acceptance
+    criterion for the post-mortem path."""
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import load_flightlog
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    model, params = model_params
+    dump_path = str(tmp_path / "fault.jsonl")
+    rec, engine = _flight_engine(
+        model, params, dump_path=dump_path,
+        guard_nonfinite=True,
+        chaos=ChaosConfig(nan_logit_slot=0, nan_logit_step=2),
+    )
+    for i in range(2):
+        engine.submit(Request(prompt=_prompt(5300 + i, 5), max_new_tokens=10))
+    done = {c.request_id: c for c in engine.run_until_idle()}
+    assert done[0].finish_reason == "nonfinite"
+    snaps = load_flightlog(dump_path)
+    assert len(snaps) == 1 and rec.n_faults == 1
+    trig = snaps[0]["trigger"]
+    assert trig["fault_kind"] == "nonfinite" and trig["slot"] == 0
+    assert trig["rid"] == 0 and "chain_step" in trig
+    # the dump fires AT the fault, before completion: the poisoned
+    # request is still a live span there, and closes with the fault
+    # finish_reason afterwards
+    assert any(s["rid"] == 0 and s.get("slot") == 0
+               for s in snaps[0]["live_spans"])
+    (nf_span,) = [s for s in rec.done_spans
+                  if s.get("finish_reason") == "nonfinite"]
+    assert nf_span["rid"] == 0
+
+
+def test_engine_stats_parts_filter(model_params):
+    """stats() unifies the per-feature dicts; the parts filter lets
+    multi-engine callers avoid clobbering (an engine with no prefix
+    cache reports prefix_cache=0 — merging that over a cache-on
+    engine's dict would lie)."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=1)
+    s = engine.stats()
+    for key in ("prefix_cache", "speculative", "adapters", "chaos",
+                "flight"):
+        assert key in s
+    assert engine.stats("fault") == engine.fault_stats()
+    assert engine.stats("flight") == {"flight": 0}
+    only = engine.stats("spec", "adapters")
+    assert "prefix_cache" not in only and "speculative" in only
+    with pytest.raises(ValueError):
+        engine.stats("nonsense")
+
+
 # ------------------------------------------------------------- the selftest
 
 def test_serve_selftest_subprocess(tmp_path):
@@ -1448,4 +1598,38 @@ def test_serve_selftest_chaos_subprocess(tmp_path):
     # selftest (a violation flips ok=False); the count is informational
     assert receipt["chaos_host_fetches"] >= 1
     assert receipt["steps_skipped"] == 1
+    # ISSUE 10: the quarantine auto-dumped flight snapshots and one of
+    # them names the poisoned slot in its trigger
+    assert receipt["chaos_flight_dumps"] >= 1
+    assert receipt["chaos_flight_named_slot"] is True
+    assert load_receipt(json_path)["ok"] is True
+
+
+def test_serve_selftest_flight_subprocess(tmp_path):
+    """``--selftest --flight`` — the flight-recorder arm (ISSUE 10):
+    recorder-on replay of the staggered stream is token-identical with
+    the fetch budget intact, every request gets a full span, event
+    counts reconcile with the engine counters, and the histogram
+    p50/p95 match sort-based percentiles within the documented bucket
+    bound."""
+    from pytorch_distributed_training_tutorials_tpu.obs import load_receipt, validate_receipt
+
+    json_path = str(tmp_path / "selftest_flight.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_training_tutorials_tpu.serve", "--selftest",
+         "--flight", "--json", json_path],
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+        env=os.environ.copy(),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    receipt = json.loads(out.stdout.strip().splitlines()[-1])
+    assert receipt["ok"] is True, receipt.get("problems")
+    assert validate_receipt(receipt, kind="serve_selftest") == []
+    assert receipt["flight"] == 1
+    assert receipt["flight_span_full"] is True
+    assert receipt["flight_events_consistent"] is True
+    assert receipt["flight_hist_vs_sort"] is True
+    assert receipt["flight_requests"] >= 3
+    assert receipt["flight_spans_done"] == receipt["flight_requests"]
+    assert receipt["e2e_count"] == receipt["flight_requests"]
     assert load_receipt(json_path)["ok"] is True

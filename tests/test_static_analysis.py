@@ -542,6 +542,39 @@ def test_naive_timing_skips_files_without_jax():
     assert not hits(check(src), "naive-timing")
 
 
+def test_naive_timing_exempts_the_jax_free_flight_recorder():
+    """The flight recorder (ISSUE 10) timestamps every event with
+    perf_counter and never fetches — correct, because it is jax-free by
+    contract (host bookkeeping, not measurement of device work). The
+    rule's jax-import gate is what makes that legal: the REAL module
+    source must sweep clean under its real path."""
+    flight_py = PKG / "obs" / "flight.py"
+    findings = analyze_file(flight_py)
+    assert not hits(findings, "naive-timing")
+    assert "import jax" not in flight_py.read_text()
+
+
+def test_naive_timing_fires_if_recorder_style_timing_moves_into_jax_code():
+    # the counter-fixture: the same timestamping idiom inside an
+    # engine-like jax-importing file IS the async mirage and must fire
+    src = """
+        import time
+        import jax
+
+        class Recorder:
+            def chain_end(self, dt):
+                self.samples.append(dt)
+
+        def run_chain(chain, state, rec):
+            t0 = time.perf_counter()
+            chain(state)
+            rec.chain_end(time.perf_counter() - t0)
+    """
+    found = hits(check(src), "naive-timing")
+    assert len(found) == 1
+    assert "no device fetch" in found[0].message
+
+
 def test_naive_timing_skips_callless_calibration_regions():
     src = """
         import time

@@ -319,6 +319,30 @@ def test_skip_step_elides_poisoned_update_and_continues():
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
 
 
+def test_skip_step_stamps_flight_event_on_batched_drain():
+    """Trainer(flight=...) surfaces nonfinite skips as flight events
+    THROUGH MetricsLogger's existing batched fetch (ISSUE 10) — the
+    event exists after the epoch drain with the right step, and a
+    no-fault guarded run stamps nothing."""
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import FlightRecorder
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+    rec = FlightRecorder(capacity=64)
+    t = _guard_trainer(
+        skip_nonfinite=True, chaos=ChaosConfig(nan_batch_step=3),
+        flight=rec,
+    )
+    t.train(1)
+    assert t.steps_skipped == 1
+    assert rec.kind_counts["step_skipped"] == 1
+    (ev,) = [e for e in rec.events if e["kind"] == "step_skipped"]
+    assert ev["step"] == 3 and rec.n_faults == 1
+    clean_rec = FlightRecorder(capacity=64)
+    t_clean = _guard_trainer(skip_nonfinite=True, flight=clean_rec)
+    t_clean.train(1)
+    assert clean_rec.n_events == 0
+
+
 def test_skip_step_guard_off_path_identical():
     """skip_nonfinite=True with NO faults changes nothing: params after a
     full epoch are bitwise equal to the guard-off trainer and the skip
