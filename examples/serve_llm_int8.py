@@ -296,6 +296,21 @@ def main():
         "flag only adds the on-disk dump",
     )
     ap.add_argument(
+        "--pipeline-depth", type=int, default=1, dest="pipeline_depth",
+        help="for --server: decode chains kept in flight before the host "
+        "fetches the oldest (serve.ServeEngine pipeline_depth; 1 = "
+        "serial, today's loop). Depth 2 dispatches chain i+1 before "
+        "fetching chain i, hiding the per-launch roundtrip — on "
+        "launch-bound runtimes the whole win, tokens byte-identical",
+    )
+    ap.add_argument(
+        "--prefill-chunk", type=int, default=0, dest="prefill_chunk",
+        help="for --server: prefill long prompts in bounded chunks of "
+        "this many tokens interleaved with decode chains (pow2 >= 8; 0 "
+        "disables) — caps the decode stall any single long prompt can "
+        "inject between chains",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -638,6 +653,8 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         adapter_bank=bank,
         default_deadline_s=args.deadline_s,
         flight=flight,
+        pipeline_depth=args.pipeline_depth,
+        prefill_chunk=args.prefill_chunk,
     )
     rng = np.random.Generator(np.random.PCG64(11))
     # one shared token family: request i's prompt = shared[:k] + tail,
@@ -678,6 +695,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     engine.adapter_requests = 0
     engine.n_deadline_expired = engine.n_cancelled = 0
     engine.nonfinite_quarantined = engine.n_prefill_errors = 0
+    engine.n_chunks = 0
     if engine.prefix is not None:
         engine.prefix.hits = engine.prefix.misses = 0
     # the warmup's compile-dominated spans would poison the percentile
@@ -755,6 +773,12 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         prefix_note += (
             f", deadline {args.deadline_s}s: "
             f"{fst['deadline_expired']} expired"
+        )
+    if args.pipeline_depth > 1 or args.prefill_chunk:
+        ps = engine.pipeline_stats()
+        prefix_note += (
+            f", pipeline depth {ps['pipeline_depth']} "
+            f"(chunk {ps['prefill_chunk']}, {ps['n_chunks']} chunks)"
         )
     print(
         f"server: {args.requests} requests (prompts {lengths}, {new} new "

@@ -100,6 +100,12 @@ CONFIG_FIELDS = (
     # by — bare rounds; the recorder's own counters (flight_events,
     # flight_dumps, ...) stay out, outcomes not configuration
     "flight",
+    # request-loop pipelining (ISSUE 11): double-buffered chains and
+    # chunked prefill change the dispatch schedule a tok/s or TTFT
+    # number was measured under, so pipelined and serial rounds are
+    # different experiments; n_chunks stays out — an outcome of the
+    # traffic mix, not configuration
+    "pipeline_depth", "prefill_chunk",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

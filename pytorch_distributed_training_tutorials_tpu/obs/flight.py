@@ -58,6 +58,7 @@ EVENT_KINDS = frozenset({
     "queue_pop",         # request left the queue for a slot
     "prefill",           # full prefill into a slot
     "splice",            # prefix-cache splice + suffix prefill
+    "prefill_chunk",     # one mid-prompt chunk of a chunked prefill
     "chain_start",       # decode chain dispatched (occupancy recorded)
     "chain_end",         # chain's batched fetch landed (tokens recorded)
     "sweep",             # chain-boundary sweep completed requests
@@ -122,7 +123,17 @@ class FlightRecorder:
             "queue_wait": LogHistogram(),
             # utilization is a ratio in (0, 1]; finer floor, tight cap
             "chain_util": LogHistogram(min_value=1e-3, max_value=4.0),
+            # pipeline overlap is a ratio too: fraction of a chain's
+            # dispatch->fetch span during which a LATER chain was
+            # already dispatched (0 = serial loop; -> 1 = the whole
+            # host roundtrip is hidden). 0.0 lands in the underflow
+            # bucket, so the count still reflects every chain.
+            "chain_overlap": LogHistogram(min_value=1e-3, max_value=4.0),
         }
+        # dispatch stamps of chains whose fetch has not landed yet,
+        # keyed by the engine's chain sequence number — pipelined
+        # engines keep several open at once
+        self._open_chains: Dict[Any, float] = {}
 
     @property
     def dropped(self) -> int:
@@ -190,6 +201,18 @@ class FlightRecorder:
             if cached_len:
                 span["cached_len"] = cached_len
 
+    def prefill_chunk(self, rid: Any, slot: int, done: int = 0,
+                      total: int = 0) -> None:
+        """One mid-prompt chunk of a chunked prefill dispatched (async
+        only — the request's ``prefill_t`` still stamps at the FINAL
+        chunk, when its first token exists). ``done``/``total`` give the
+        prompt progress for the timeline view."""
+        self.record("prefill_chunk", rid=rid, slot=slot, done=done,
+                    total=total)
+        span = self.spans.get(rid)
+        if span is not None:
+            span["chunks"] = span.get("chunks", 0) + 1
+
     def request_completed(self, rid: Any, finish_reason: str,
                           tokens: int = 0,
                           latency_s: Optional[float] = None,
@@ -227,13 +250,44 @@ class FlightRecorder:
 
     # -- engine-wide events ------------------------------------------------
 
-    def chain_start(self, occupancy: int, n_slots: int) -> None:
-        self.record("chain_start", occupancy=occupancy, n_slots=n_slots)
+    def chain_start(self, occupancy: int, n_slots: int,
+                    chain: Optional[int] = None) -> None:
+        """``chain`` is the engine's chain sequence number; when given,
+        the dispatch stamp opens the chain for the overlap histogram
+        (and rides the event, so flight_view can pair start/end of
+        overlapped chains without reordering the timeline)."""
+        fields: dict = {"occupancy": occupancy, "n_slots": n_slots}
+        if chain is not None:
+            fields["chain"] = chain
+        ev = self.record("chain_start", **fields)
+        if chain is not None:
+            self._open_chains[chain] = ev["t"]
         if n_slots:
             self.hist["chain_util"].record(occupancy / n_slots)
 
-    def chain_end(self, tokens: int, occupancy: int) -> None:
-        self.record("chain_end", tokens=tokens, occupancy=occupancy)
+    def chain_end(self, tokens: int, occupancy: int,
+                  chain: Optional[int] = None) -> None:
+        fields: dict = {"tokens": tokens, "occupancy": occupancy}
+        if chain is not None:
+            fields["chain"] = chain
+        ev = self.record("chain_end", **fields)
+        if chain is None:
+            return
+        start = self._open_chains.pop(chain, None)
+        if start is None:
+            return
+        span = ev["t"] - start
+        # overlap = fraction of this chain's dispatch->fetch span during
+        # which a LATER chain was already in flight — the pipelining
+        # receipt, straight from the stamps the engine already makes
+        later = [
+            t0 for c, t0 in self._open_chains.items()
+            if c > chain and t0 < ev["t"]
+        ]
+        overlap = 0.0
+        if span > 0 and later:
+            overlap = min(1.0, max(0.0, (ev["t"] - min(later)) / span))
+        self.hist["chain_overlap"].record(overlap)
 
     def sweep(self, completed: int) -> None:
         self.record("sweep", completed=completed)
@@ -306,6 +360,9 @@ class FlightRecorder:
             self.hist["queue_wait"].summary(prefix="queue_wait_", unit="s")
         )
         out.update(self.hist["chain_util"].summary(prefix="chain_util_"))
+        out.update(
+            self.hist["chain_overlap"].summary(prefix="chain_overlap_")
+        )
         return {
             k: (round(v, 6) if isinstance(v, float) else v)
             for k, v in out.items()

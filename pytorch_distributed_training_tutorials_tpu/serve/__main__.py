@@ -47,7 +47,14 @@ reconcile with the engine's own counters, and the streaming-histogram
 p50/p95 match sort-based percentiles within one bucket's documented
 relative error. The receipt gains the ``fault_stats()`` fields plus
 ``steps_skipped``, and the per-arm stats now flow through ONE
-``engine.stats(part)`` aggregate. Prints exactly one JSON line (a
+``engine.stats(part)`` aggregate. A seventh (``--pipeline``) arm replays
+the staggered stream through a ``pipeline_depth=2`` + chunked-prefill
+engine (ISSUE 11): greedy tokens must stay byte-identical to the serial
+engine (double-buffering moves the fetch off the critical path, never
+changes what was computed), the fetch budget is unchanged (mid-prefill
+chunks are pure dispatch — no fetch until the final chunk), and the
+chunking mechanism must have fired (``n_chunks > 0`` on a stream whose
+longest prompt exceeds the chunk). Prints exactly one JSON line (a
 ``graft-receipt/v1`` envelope) and exits non-zero on any failure.
 """
 
@@ -61,7 +68,7 @@ import sys
 
 def selftest(json_path: str | None = None, spec_k: int = 2,
              adapters: int = 3, chaos: bool = False,
-             flight: bool = False) -> dict:
+             flight: bool = False, pipeline: bool = False) -> dict:
     import math
     import tempfile
 
@@ -561,6 +568,74 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
         }
 
     # ------------------------------------------------------------------
+    # pipeline arm (--pipeline, ISSUE 11): the staggered base stream
+    # again, now through a depth-2 double-buffered engine with chunked
+    # prefill — tokens must stay byte-identical to the serial engine
+    # (the pipeline only moves the fetch off the critical path), the
+    # fetch budget is unchanged (mid-chunks are pure dispatch), and
+    # chunking must visibly fire on the stream's 12-token prompt
+    # ------------------------------------------------------------------
+    pipeline_fields: dict = {}
+    if pipeline:
+        eng_p = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=8,
+            pipeline_depth=2, prefill_chunk=8,
+        )
+        count = {"n": 0}
+
+        def counting_p(x):
+            count["n"] += 1
+            return real_get(x)
+
+        jax.device_get = counting_p
+        try:
+            comp_p = {}
+            pending = list(prompts)
+            for toks, max_new in pending[:2]:
+                eng_p.submit(Request(prompt=toks, max_new_tokens=max_new))
+            pending = pending[2:]
+            while not eng_p.idle or pending:
+                while pending:
+                    toks, max_new = pending[0]
+                    try:
+                        eng_p.submit(
+                            Request(prompt=toks, max_new_tokens=max_new)
+                        )
+                        pending.pop(0)
+                    except QueueFull:
+                        break
+                for c in eng_p.step():
+                    comp_p[c.request_id] = c
+        finally:
+            jax.device_get = real_get
+        pipeline_exact = {r: c.tokens for r, c in comp_p.items()} == {
+            r: c.tokens for r, c in completions.items()
+        }
+        if not pipeline_exact:
+            problems.append(
+                "pipelined engine changed greedy tokens vs serial"
+            )
+        p_budget = eng_p.n_chains + eng_p.n_prefills + eng_p.n_splices
+        if count["n"] > p_budget:
+            problems.append(
+                f"pipeline arm: {count['n']} host fetches > {p_budget} "
+                f"({eng_p.n_chains} chains + {eng_p.n_prefills} prefills "
+                f"+ {eng_p.n_splices} splices — chunks must add none)"
+            )
+        pstats = eng_p.stats("pipeline")
+        if pstats.get("n_chunks", 0) < 1:
+            problems.append(
+                f"chunked prefill never fired on a 12-token prompt with "
+                f"an 8-token chunk: {pstats}"
+            )
+        pipeline_fields = {
+            "pipeline_requests": len(prompts),
+            "pipeline_token_exact": pipeline_exact,
+            "pipeline_host_fetches": count["n"],
+            **pstats,
+        }
+
+    # ------------------------------------------------------------------
     # chaos arm (--chaos, ISSUE 9): one staggered stream exercising every
     # serving failure path — injected NaN logits (quarantine), a deadline
     # expiry, a host-side cancel, close/drain — with the fetch budget
@@ -778,6 +853,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             "adapter_host_fetches": fetches_mix,
             **astats,
             **flight_fields,
+            **pipeline_fields,
             **fault_fields,
             "problems": problems,
             "ok": not problems,
@@ -823,6 +899,12 @@ def main(argv: list[str] | None = None) -> int:
         "histogram-vs-sort percentile parity, unchanged fetch budget "
         "(ISSUE 10)",
     )
+    parser.add_argument(
+        "--pipeline", action="store_true",
+        help="also run the pipelined arm: depth-2 double-buffered "
+        "chains + chunked prefill, token-identical to serial with the "
+        "same fetch budget (ISSUE 11)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -843,7 +925,7 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", "cpu")
     receipt = selftest(args.json, spec_k=args.spec_k,
                        adapters=args.adapters, chaos=args.chaos,
-                       flight=args.flight)
+                       flight=args.flight, pipeline=args.pipeline)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

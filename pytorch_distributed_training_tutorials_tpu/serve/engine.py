@@ -98,6 +98,34 @@ Guard/deadline/chaos OFF keeps the state tree and compiled programs
 byte-identical to the pre-robustness engine (the same Python-default
 trick the prefix cache, speculation, and adapter bank use).
 
+Pipelining (ISSUE 11) hides the per-LAUNCH host roundtrip (~75-130 ms
+on the tunneled runtime, vs ~3.6 ms of device work per 1.2B int8 step)
+behind device execution:
+
+- ``pipeline_depth=2`` double-buffers decode chains: chain ``i+1`` (and
+  any prefill/splice for slots freed at chain ``i-1``'s observed
+  boundary) is DISPATCHED before chain ``i``'s batched fetch — JAX
+  async dispatch queues it device-side, so the device never idles on
+  the roundtrip. Host bookkeeping (sweep, distribute, refill) runs one
+  chain behind the device: "chain boundary" for deadlines / cancel /
+  quarantine means the OBSERVED boundary (one chain late at depth 2;
+  tokens earned before it are kept, exactly as before). Token-exactness
+  is unaffected because chain ``i+1``'s inputs are device-resident
+  state, never chain ``i``'s fetched tokens; a slot whose request
+  finished in chain ``i`` junk-decodes one extra chain (its rows are
+  dropped by an identity check against the slot view snapshotted at
+  dispatch) and parks/refills as usual. Depth 1 IS the serial loop —
+  byte-identical state tree and compiled programs;
+- ``prefill_chunk=N`` caps prefill work per scheduling quantum: a
+  prompt whose uncached length exceeds N prefills in N-token chunks
+  through the SAME bitwise-equal chunked decode continuation splices
+  use, one chunk per :meth:`ServeEngine.step`, interleaved with decode
+  chains — a 2048-token prompt no longer freezes co-scheduled slots.
+  Chunks accumulate in a batch-1 side cache (never the slot state); the
+  final chunk splices into the slot exactly like a prefix-cache hit and
+  only THAT chunk fetches the first token, so the fetch budget stays
+  chains + prefills + splices in every configuration.
+
 Greedy decoding is token-exact vs one-shot ``generate()`` (same math,
 same cache semantics; pinned by tests/test_serve.py). Temperature /
 top-k / top-p are ENGINE-level statics — per-request sampling params
@@ -107,6 +135,7 @@ every step; per-request randomness comes from per-request seeds.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 
@@ -135,6 +164,7 @@ from pytorch_distributed_training_tutorials_tpu.serve.slots import (
     seed_cache,
     tree_nbytes,
     write_slot,
+    zero_cache,
 )
 from pytorch_distributed_training_tutorials_tpu.utils import chaos as chaos_lib
 
@@ -152,6 +182,45 @@ class _Active:
         self.remaining = request.max_new_tokens - 1
         self.segment = None
         self.ttft_s = 0.0
+
+
+class _InFlight:
+    """One dispatched-but-not-yet-fetched decode chain: the chain's
+    output futures, a shallow snapshot of the slot views at dispatch
+    (the identity guard — a slot completed or refilled inside the
+    pipeline window must not consume this chain's junk rows), and the
+    chain's sequence number for the flight recorder's overlap stamp."""
+
+    __slots__ = ("out", "view", "chain_id")
+
+    def __init__(self, out, view, chain_id: int):
+        self.out = out
+        self.view = view
+        self.chain_id = chain_id
+
+
+class _PendingPrefill:
+    """Host-side record of a chunked prefill in progress: the request,
+    its target slot, the accumulating batch-1 side cache (device
+    futures — chunks are async dispatches, never fetched), and how many
+    prompt tokens (``done``, INCLUDING any spliced prefix ``depth``)
+    the cache already holds. The slot's device-side budget stays 0
+    until the final chunk, so decode chains treat it as inactive."""
+
+    __slots__ = ("request", "slot", "cache1", "prompt", "aid", "done",
+                 "depth", "segment", "grow", "pkey")
+
+    def __init__(self, request: Request, slot: int):
+        self.request = request
+        self.slot = slot
+        self.cache1 = None
+        self.prompt: list[int] = []
+        self.aid = 0
+        self.done = 0
+        self.depth = 0
+        self.segment = None
+        self.grow = False
+        self.pkey: list[int] = []
 
 
 class ServeEngine:
@@ -192,6 +261,8 @@ class ServeEngine:
         guard_nonfinite: bool = False,
         chaos=None,
         flight=None,
+        pipeline_depth: int = 1,
+        prefill_chunk: int = 0,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -199,6 +270,16 @@ class ServeEngine:
             raise ValueError("tokens_per_launch must be >= 1")
         if speculative_k < 0:
             raise ValueError("speculative_k must be >= 0")
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1 (1 = serial)")
+        if prefill_chunk and (
+            prefill_chunk < 8 or prefill_chunk & (prefill_chunk - 1)
+        ):
+            raise ValueError(
+                "prefill_chunk must be 0 (off) or a power of two >= 8 "
+                "(chunk lengths must come from the pow2 bucket set so "
+                "compiles stay bounded)"
+            )
         if default_deadline_s is not None and default_deadline_s <= 0:
             raise ValueError(
                 "default_deadline_s must be > 0 (None = no deadline)"
@@ -259,10 +340,20 @@ class ServeEngine:
             PrefixIndex(prefix_cache_bytes) if self._retain else None
         )
         self._min_hit_depth = int(min_hit_depth)
-        if self._retain:
+        # software pipeline (ISSUE 11): depth 1 = today's serial loop
+        # (dispatch then fetch in the same step — byte-identical state
+        # tree and compiled programs); depth 2 keeps one chain in flight
+        # across the host roundtrip. prefill_chunk = 0 disables chunked
+        # prefill (every prompt prefills whole, as before).
+        self._depth = int(pipeline_depth)
+        self._chunk = int(prefill_chunk)
+        self._inflight: collections.deque[_InFlight] = collections.deque()
+        self._pending: dict[int, _PendingPrefill] = {}
+        self.n_chunks = 0
+        if self._retain or self._chunk:
             # shape/dtype proto of the batch-1 decode cache — seed_cache
-            # builds the splice start state from it (eval_shape: no FLOPs,
-            # no buffers)
+            # builds the splice start state from it, and chunked prefill
+            # its zeroed side cache (eval_shape: no FLOPs, no buffers)
             self._proto1 = jax.eval_shape(
                 lambda p, t: self.model.apply(
                     {"params": p}, t, decode=True, mutable=["cache"]
@@ -337,6 +428,26 @@ class ServeEngine:
         self._park = jax.jit(
             _park_slot, donate_argnums=(0,) if donate else ()
         )
+        # chunked-prefill programs exist only when the feature is on —
+        # chunk-off engines compile (and trace) nothing new. The seeded
+        # segment is never donated (the index keeps serving it); the
+        # side cache IS donated between chunks (it has exactly one
+        # consumer), as is the slot state into the final splice.
+        if self._chunk:
+            self._chunk_zero = jax.jit(lambda: zero_cache(self._proto1))
+            self._chunk_seed = jax.jit(
+                lambda segment, depth: seed_cache(
+                    self._proto1, segment, depth
+                )
+            )
+            self._chunk_step = jax.jit(
+                self._chunk_step_fn, donate_argnums=donate
+            )
+            self._chunk_final = jax.jit(
+                self._chunk_final_fn,
+                static_argnames=("seg_len", "grow"),
+                donate_argnums=(1, 2) if donate else (),
+            )
 
     # ------------------------------------------------------------------
     # compiled programs (closures over model + static sampling params)
@@ -435,9 +546,23 @@ class ServeEngine:
         if self._adapters:
             kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
         cache1 = seed_cache(self._proto1, segment, depth)
+        return self._finish_prefill(
+            params, cache1, state, suffix, p_len - 1 - depth, full,
+            p_len, slot, seed, max_new, aid, kw, seg_len, grow,
+        )
+
+    def _finish_prefill(self, params, cache1, state, suffix, last_local,
+                        full, p_len, slot, seed, max_new, aid, kw,
+                        seg_len, grow):
+        """Shared tail of :meth:`_splice_fn` and :meth:`_chunk_final_fn`:
+        run the chunked decode continuation over ``suffix`` from the
+        batch-1 ``cache1``, sample the first token from the logits at
+        local position ``last_local``, and splice the result into
+        ``slot``. A plain helper, not a jit target — it traces inline in
+        its callers, so factoring it out changed neither jaxpr."""
         logits, upd = self.model.apply(
             {"params": params, "cache": cache1}, suffix, decode=True,
-            mutable=["cache"], last_pos=p_len - 1 - depth, **kw,
+            mutable=["cache"], last_pos=last_local, **kw,
         )
         key = jax.random.PRNGKey(seed)
         first, key = sample_logits(
@@ -467,6 +592,44 @@ class ServeEngine:
                 jnp.asarray(aid, jnp.int32)
             )
         return new_state, first[0], seg
+
+    def _chunk_step_fn(self, params, cache1, tokens, aid=0):
+        """One mid-prompt prefill chunk (chunked prefill, ISSUE 11):
+        the same chunked decode continuation the splice path relies on,
+        over exactly ``prefill_chunk`` tokens, batch-1 side cache in ->
+        side cache out. No sampling, no slot surgery, no fetch — the
+        call is one async dispatch, so a long prompt costs its
+        co-scheduled slots one chunk of device time per step, never the
+        whole prompt. ``last_pos=0`` keeps the dead lm-head gather
+        trivial (mid-chunk logits are never consumed)."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
+        _, upd = self.model.apply(
+            {"params": params, "cache": cache1}, tokens, decode=True,
+            mutable=["cache"], last_pos=0, **kw,
+        )
+        return upd["cache"]
+
+    def _chunk_final_fn(self, params, cache1, state, suffix, full,
+                        last_local, p_len, slot, seed, max_new, aid=0,
+                        *, seg_len, grow):
+        """Final chunk of a chunked prefill: identical math to
+        :meth:`_splice_fn` except the batch-1 start cache arrives as an
+        ARGUMENT (the accumulated side cache) instead of being seeded
+        from a retained segment. With ``grow`` the FULL prompt's segment
+        rides out for insertion — the side cache holds every position,
+        so chunked prompts deepen the prefix index exactly like whole
+        prefills do. ``seg_len``/``grow`` static, same bucket discipline
+        as the splice; ``last_local`` is the final chunk's last REAL
+        token position (traced)."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
+        return self._finish_prefill(
+            params, cache1, state, suffix, last_local, full,
+            p_len, slot, seed, max_new, aid, kw, seg_len, grow,
+        )
 
     def _chain_fn(self, params, state):
         """``tokens_per_launch`` decode steps as one ``lax.scan`` — one
@@ -713,17 +876,28 @@ class ServeEngine:
 
     @property
     def idle(self) -> bool:
-        return self.active_slots == 0 and len(self.scheduler) == 0
+        return (
+            self.active_slots == 0
+            and len(self.scheduler) == 0
+            and not self._pending
+            and not self._inflight
+        )
 
     def step(self) -> list[Completion]:
         """One scheduling round: sweep deadline/cancel state over the
-        active slots (host bookkeeping at the chain boundary — the ONLY
-        place in-flight requests are interrupted), refill free slots
-        from the queue (one prefill launch each), then run ONE decode
-        chain over all slots and hand out its tokens. Returns the
-        requests that finished this round (possibly mid-chain — surplus
-        chain tokens for a finished slot are discarded, exactly like
-        ``generate()`` truncating at ``max_new_tokens``)."""
+        active slots (host bookkeeping at the OBSERVED chain boundary —
+        the ONLY place in-flight requests are interrupted), advance any
+        chunked prefills by one chunk, refill free slots from the queue
+        (one prefill launch each), DISPATCH one decode chain over all
+        slots, then fetch the oldest in-flight chain and hand out its
+        tokens. At ``pipeline_depth=1`` the dispatched chain IS the
+        fetched chain — today's serial loop, op for op; at depth 2 the
+        fetch trails dispatch by one chain, so the ~100 ms host
+        roundtrip overlaps device execution and host bookkeeping runs
+        one chain behind the device. Returns the requests that finished
+        this round (possibly mid-chain — surplus chain tokens for a
+        finished slot are discarded, exactly like ``generate()``
+        truncating at ``max_new_tokens``)."""
         if self._adapters and self._bank.version != self._merged_version:
             # register/evict moved the bank since the last merge: pick
             # the new factors up BEFORE refilling, so freshly admitted
@@ -734,20 +908,23 @@ class ServeEngine:
         done: list[Completion] = list(self._sweep())
         if self._flight is not None and done:
             self._flight.sweep(len(done))
+        done.extend(self._advance_pending())
         for s in range(self.n_slots):
-            if self._slots[s] is not None:
+            if self._slots[s] is not None or s in self._pending:
                 continue
-            req = self.scheduler.pop()
+            req = self._pop_request()
             if req is None:
                 break
             if self._flight is not None:
                 self._flight.request_popped(req.request_id)
             done.extend(self._refill(s, req))
         if self.active_slots:
+            chain_id = self.n_chains
             if self._flight is not None:
                 # occupancy at dispatch = chain utilization sample
-                self._flight.chain_start(self.active_slots, self.n_slots)
-                gen_before = self.generated_tokens
+                self._flight.chain_start(
+                    self.active_slots, self.n_slots, chain=chain_id
+                )
             if self._chaos is not None:
                 chaos_lib.maybe_stall(
                     self._chaos, self.n_chains, flight=self._flight
@@ -761,31 +938,67 @@ class ServeEngine:
                 ))
             else:
                 args = (self.params, self._state)
+            # async dispatch: self._state becomes the chain's OUTPUT
+            # futures. Later parks/prefills/chains consume them without
+            # a host sync — device program order runs them after this
+            # chain — so the fetch below is the only place the host
+            # waits.
+            self._state, out = self._chain(*args)
+            self.n_chains += 1
             if self._spec:
-                self._state, out = self._chain(*args)
-                self.n_chains += 1
                 self.n_verify_forwards += self.tokens_per_launch
-                fetched = jax.device_get(out)  # ONE batched fetch
-                if self._guard:
-                    toks, counts, oks = fetched
-                else:
-                    (toks, counts), oks = fetched, None
-                done.extend(self._distribute_spec(toks, counts, oks))
-            else:
-                self._state, out = self._chain(*args)
-                self.n_chains += 1
-                fetched = jax.device_get(out)  # the chain's ONE host fetch
-                if self._guard:
-                    toks, oks = fetched
-                else:
-                    toks, oks = fetched, None
-                done.extend(self._distribute(toks, oks))
-            if self._flight is not None:
-                self._flight.chain_end(
-                    tokens=self.generated_tokens - gen_before,
-                    occupancy=self.active_slots,
-                )
+            self._inflight.append(
+                _InFlight(out, list(self._slots), chain_id)
+            )
+        # fetch the oldest chain(s). While slots are active, keep
+        # depth-1 chains in flight (depth 1: fetch what was just
+        # dispatched — serial); once the observed stream is empty, drain
+        # fully (trailing chains carry only junk-decode of parked or
+        # naturally-exhausted slots, dropped by the view identity check).
+        target = self._depth - 1 if self.active_slots else 0
+        while len(self._inflight) > target:
+            done.extend(self._collect_chain())
         return done
+
+    def _collect_chain(self) -> list[Completion]:
+        """Fetch the OLDEST in-flight chain (ONE batched ``device_get``
+        — the chain's budgeted fetch) and hand its tokens to the slot
+        views snapshotted at its dispatch. A slot that completed or was
+        refilled inside the pipeline window fails the snapshot identity
+        check in the distribute and ignores this chain's junk rows."""
+        fl = self._inflight.popleft()
+        fetched = jax.device_get(fl.out)  # the chain's ONE host fetch
+        gen_before = self.generated_tokens
+        if self._spec:
+            if self._guard:
+                toks, counts, oks = fetched
+            else:
+                (toks, counts), oks = fetched, None
+            done = self._distribute_spec(toks, counts, oks, view=fl.view)
+        else:
+            if self._guard:
+                toks, oks = fetched
+            else:
+                toks, oks = fetched, None
+            done = self._distribute(toks, oks, view=fl.view)
+        if self._flight is not None:
+            self._flight.chain_end(
+                tokens=self.generated_tokens - gen_before,
+                occupancy=self.active_slots,
+                chain=fl.chain_id,
+            )
+        return done
+
+    def _pop_request(self) -> Request | None:
+        """Queue pop, chunk-aware when chunked prefill is on: with a
+        long prompt already mid-chunked-prefill, only requests that fit
+        one chunk pop (they slip around the long one into free slots
+        instead of queueing a second multi-step prefill behind it)."""
+        if self._chunk:
+            return self.scheduler.pop(
+                chunk=self._chunk, pending_long=len(self._pending)
+            )
+        return self.scheduler.pop()
 
     def _deadline_for(self, req: Request) -> float | None:
         return (
@@ -854,6 +1067,9 @@ class ServeEngine:
         known = any(
             a is not None and a.request.request_id == request_id
             for a in self._slots
+        ) or any(
+            p.request.request_id == request_id
+            for p in self._pending.values()
         ) or self.scheduler.has(request_id)
         if known:
             self._cancelled.add(request_id)
@@ -946,6 +1162,15 @@ class ServeEngine:
             else None
         )
         grow = self.prefix is not None and tuple(pkey) not in self.prefix
+        if self._chunk and (
+            p_len - (hit[0] if hit is not None else 0) > self._chunk
+        ):
+            # chunked prefill: the uncached length exceeds the per-step
+            # quantum — stream it in chunks instead of stalling every
+            # co-scheduled slot for the whole prompt
+            return self._begin_chunked(
+                slot, req, prompt, p_len, pkey, hit, grow, aid
+            )
         segment = None
         try:
             if self._chaos is not None:
@@ -1009,6 +1234,18 @@ class ServeEngine:
                 self._state["remaining"], slot
             )
             return [self._complete_unstarted(req, "error")]
+        return self._activate(
+            slot, req, first, segment,
+            hit[0] if segment is not None else 0,
+        )
+
+    def _activate(self, slot: int, req: Request, first: int, segment,
+                  cached_len: int) -> list[Completion]:
+        """Admit a just-prefilled request into the decode phase — the
+        shared tail of :meth:`_refill` and a chunked prefill's final
+        chunk. ``segment`` pins the splice donor until completion; an
+        EOS / ``max_new == 1`` first token completes immediately and
+        parks the slot (its device-side counter still shows budget)."""
         self.generated_tokens += 1
         act = _Active(req, first)
         act.ttft_s = time.perf_counter() - req.submitted_s
@@ -1018,7 +1255,7 @@ class ServeEngine:
             self._flight.request_prefilled(
                 req.request_id, slot,
                 kind="splice" if segment is not None else "prefill",
-                cached_len=hit[0] if segment is not None else 0,
+                cached_len=cached_len,
             )
         if segment is not None:
             act.segment = segment
@@ -1033,6 +1270,162 @@ class ServeEngine:
             return [self._complete(act, reason)]
         self._slots[slot] = act
         return []
+
+    def _begin_chunked(self, slot: int, req: Request, prompt: list[int],
+                       p_len: int, pkey: list[int], hit, grow: bool,
+                       aid: int) -> list[Completion]:
+        """Start a chunked prefill (ISSUE 11 leg b): seed a batch-1 side
+        cache — zeroed, or spliced from a prefix-cache hit at its
+        matched depth — and register the slot as pending. Chunks advance
+        one per :meth:`step` via :meth:`_advance_pending`; until the
+        final chunk lands, the slot's device budget stays 0 (decode
+        chains treat it as inactive) and no fetch happens, so
+        co-scheduled slots keep decoding while this prompt streams in."""
+        pend = _PendingPrefill(req, slot)
+        pend.prompt = prompt
+        pend.aid = aid
+        pend.grow = grow
+        pend.pkey = pkey
+        try:
+            if self._chaos is not None:
+                chaos_lib.maybe_fail_prefill(self._chaos, req.request_id)
+            if hit is not None:
+                depth, segment = hit
+                # pin the donor FIRST, same contract as _refill
+                self.prefix.acquire(segment)
+                pend.segment = segment
+                pend.depth = depth
+                pend.cache1 = self._chunk_seed(segment.handle, depth)
+            else:
+                pend.cache1 = self._chunk_zero()
+        except Exception:
+            if pend.segment is not None:
+                self.prefix.release(pend.segment)
+            self.n_prefill_errors += 1
+            if self._flight is not None:
+                self._flight.fault(
+                    "prefill_error", rid=req.request_id, slot=slot
+                )
+            # no park needed: the slot was free, its device budget is 0
+            return [self._complete_unstarted(req, "error")]
+        pend.done = pend.depth
+        self._pending[slot] = pend
+        # the first chunk runs in the SAME step the slot was claimed —
+        # a pending prefill never wastes its admission round
+        return self._advance_one(pend)
+
+    def _advance_pending(self) -> list[Completion]:
+        """Advance every chunked prefill by ONE chunk — the per-step
+        prefill quantum. Mid chunks are a single async dispatch into the
+        pending request's side cache (no fetch); a final chunk splices
+        into the slot and fetches the first token (the budgeted
+        prefill/splice fetch). Runs BEFORE refill in :meth:`step`, so a
+        prefill begun this round is not advanced twice."""
+        done: list[Completion] = []
+        for slot in list(self._pending):
+            done.extend(self._advance_one(self._pending[slot]))
+        return done
+
+    def _advance_one(self, pend: _PendingPrefill) -> list[Completion]:
+        req = pend.request
+        slot = pend.slot
+        # pending prefills honor the same boundary lifecycle as queued
+        # requests: cancel/deadline complete them with zero tokens (the
+        # side cache is dropped, the donor segment unpinned)
+        if req.request_id in self._cancelled:
+            self._cancelled.discard(req.request_id)
+            self.n_cancelled += 1
+            self._abandon_pending(pend)
+            return [self._complete_unstarted(req, "cancelled")]
+        dl = self._deadline_for(req)
+        if dl is not None and time.perf_counter() - req.submitted_s > dl:
+            self.n_deadline_expired += 1
+            if self._flight is not None:
+                self._flight.fault(
+                    "deadline", rid=req.request_id, slot=slot
+                )
+            self._abandon_pending(pend)
+            return [self._complete_unstarted(req, "deadline")]
+        p_len = len(pend.prompt)
+        rem = p_len - pend.done
+        akw = {"aid": pend.aid} if self._adapters else {}
+        try:
+            if rem > self._chunk:
+                # mid chunk: exactly prefill_chunk tokens (full chunks
+                # need no padding — ONE compiled shape), async dispatch
+                # only
+                tokens = jnp.asarray(
+                    [pend.prompt[pend.done:pend.done + self._chunk]],
+                    jnp.int32,
+                )
+                pend.cache1 = self._chunk_step(
+                    self.params, pend.cache1, tokens, **akw
+                )
+                pend.done += self._chunk
+                self.n_chunks += 1
+                if self._flight is not None:
+                    self._flight.prefill_chunk(
+                        req.request_id, slot, done=pend.done, total=p_len
+                    )
+                return []
+            # final chunk: splice into the slot + fetch the first token
+            # (THE budgeted prefill/splice fetch for this request)
+            f_bucket = bucket_len(rem, self.window)
+            suffix = pend.prompt[pend.done:]
+            tokens = jnp.asarray(
+                [suffix + [0] * (f_bucket - rem)], jnp.int32
+            )
+            bucket = bucket_len(p_len, self.window)
+            full = (
+                jnp.asarray(
+                    [pend.prompt + [0] * (bucket - p_len)], jnp.int32
+                )
+                if self._spec
+                else tokens  # dead operand when speculation is off
+            )
+            self._state, first, new_seg = self._chunk_final(
+                self.params, pend.cache1, self._state, tokens, full,
+                rem - 1, p_len, slot, req.seed, req.max_new_tokens,
+                seg_len=bucket, grow=pend.grow, **akw,
+            )
+            self.n_chunks += 1
+            if pend.segment is not None:
+                self.n_splices += 1
+                self.prefix_hit_tokens += pend.depth
+            else:
+                self.n_prefills += 1
+            if pend.grow:
+                self.prefix.insert(
+                    tuple(pend.pkey), new_seg, tree_nbytes(new_seg)
+                )
+            first = int(jax.device_get(first))
+        except Exception:
+            self._abandon_pending(pend)
+            self.n_prefill_errors += 1
+            if self._flight is not None:
+                self._flight.fault(
+                    "prefill_error", rid=req.request_id, slot=slot
+                )
+            # defensive park, same as _refill: the final chunk may have
+            # set the slot's device budget before raising
+            self._state["remaining"] = self._park(
+                self._state["remaining"], slot
+            )
+            return [self._complete_unstarted(req, "error")]
+        segment = pend.segment
+        cached_len = pend.depth
+        del self._pending[slot]
+        return self._activate(slot, req, first, segment, cached_len)
+
+    def _abandon_pending(self, pend: _PendingPrefill) -> None:
+        """Drop a pending chunked prefill: unpin its splice donor and
+        free the slot for the next refill. The side cache futures are
+        simply released (nothing was spliced into slot state, and the
+        slot's device budget was never set — no park needed)."""
+        if pend.segment is not None:
+            self.prefix.release(pend.segment)
+            pend.segment = None
+        self._pending.pop(pend.slot, None)
 
     def _prefix_key(self, prompt: list[int], aid: int) -> list[int]:
         """Tenant-scoped prefix-index key: shift every token by
@@ -1054,11 +1447,18 @@ class ServeEngine:
         shift = ns * int(self.model.cfg.vocab_size)
         return [t + shift for t in prompt]
 
-    def _distribute(self, toks, oks=None) -> list[Completion]:
+    def _distribute(self, toks, oks=None, view=None) -> list[Completion]:
         """Hand one fetched (S, T) chain block out to the slots' host
         views; free every slot that finished (budget exhausted or EOS
         mid-chain) and park early-EOS slots whose device counter still
         shows budget.
+
+        ``view`` is the slot snapshot taken when this chain was
+        DISPATCHED (``None`` = the live slots, the depth-1 case where
+        nothing can change in between): a slot whose ``_Active`` is no
+        longer the live one — completed or refilled inside the pipeline
+        window — fails the identity check and ignores this chain's junk
+        rows.
 
         ``oks`` (guard on) is the fetched (S, T) finite-logits flag: the
         first False step for a slot means that step's token — and
@@ -1069,8 +1469,8 @@ class ServeEngine:
         the per-slot forward is independent across the batch dim, so
         co-scheduled requests decode token-identically to a clean run."""
         done: list[Completion] = []
-        for s, act in enumerate(self._slots):
-            if act is None:
+        for s, act in enumerate(self._slots if view is None else view):
+            if act is None or act is not self._slots[s]:
                 continue
             reason = None
             for t, tok_ in enumerate(toks[s, : act.remaining]):
@@ -1101,7 +1501,8 @@ class ServeEngine:
                 done.append(self._complete(act, reason))
         return done
 
-    def _distribute_spec(self, toks, counts, oks=None) -> list[Completion]:
+    def _distribute_spec(self, toks, counts, oks=None,
+                         view=None) -> list[Completion]:
         """Speculative twin of :meth:`_distribute`: unpack one fetched
         (S, T, k+1) block. Step t of slot s contributed ``counts[s, t]``
         real tokens — the accepted draft prefix plus the bonus/rejection
@@ -1109,12 +1510,13 @@ class ServeEngine:
         the request's budget exactly like ``generate()`` does (the device
         may have verified past it within the chain; those writes land in
         the slot's own window and refill rewrites the whole slot).
-        ``oks`` follows the :meth:`_distribute` quarantine contract at
-        verify-step granularity: a poisoned verify step discards all of
-        that step's emissions."""
+        ``view`` follows the :meth:`_distribute` pipeline-window identity
+        contract; ``oks`` the quarantine contract at verify-step
+        granularity (a poisoned verify step discards all of that step's
+        emissions)."""
         done: list[Completion] = []
-        for s, act in enumerate(self._slots):
-            if act is None:
+        for s, act in enumerate(self._slots if view is None else view):
+            if act is None or act is not self._slots[s]:
                 continue
             reason = None
             for t in range(counts.shape[1]):
@@ -1299,7 +1701,22 @@ class ServeEngine:
             return {"flight": 0}
         return self._flight.summary()
 
-    _STATS_PARTS = ("prefix", "spec", "adapters", "fault", "flight")
+    def pipeline_stats(self) -> dict[str, int | float]:
+        """Pipelining counters for the serving receipt (ISSUE 11):
+        configured depth / prefill quantum plus how many prefill chunks
+        ran. regress.py fingerprints ``pipeline_depth`` /
+        ``prefill_chunk`` so pipelined and serial rounds never gate each
+        other; ``n_chunks`` is an outcome and stays out. Host
+        bookkeeping only — no device fetch."""
+        return {
+            "pipeline_depth": self._depth,
+            "prefill_chunk": self._chunk,
+            "n_chunks": self.n_chunks,
+        }
+
+    _STATS_PARTS = (
+        "prefix", "spec", "adapters", "fault", "flight", "pipeline"
+    )
 
     def stats(self, *parts: str) -> dict[str, int | float]:
         """ONE aggregate over every per-subsystem stats dict — the
@@ -1322,6 +1739,7 @@ class ServeEngine:
             "adapters": self.adapter_stats,
             "fault": self.fault_stats,
             "flight": self.flight_stats,
+            "pipeline": self.pipeline_stats,
         }
         out: dict[str, int | float] = {}
         for part in self._STATS_PARTS:

@@ -170,6 +170,24 @@ class FifoScheduler:
         self._queue.append(request)
         return request.request_id
 
-    def pop(self) -> Request | None:
-        """Next request in arrival order, or None when idle."""
-        return self._queue.popleft() if self._queue else None
+    def pop(self, chunk: int = 0, pending_long: int = 0) -> Request | None:
+        """Next request in arrival order, or None when idle.
+
+        Chunk-aware admission (ISSUE 11): with ``chunk`` set (the
+        engine's ``prefill_chunk``) and a long prompt already mid
+        chunked-prefill (``pending_long > 0``), only a request whose
+        prompt fits a single chunk may pop — short requests slip AROUND
+        the long one into free slots instead of queueing a second
+        multi-step prefill behind it, and the long request keeps its
+        arrival-order claim on the next free slot once the pending one
+        lands. The defaults are the plain FIFO, byte-identical behavior
+        for non-chunked engines."""
+        if not self._queue:
+            return None
+        if chunk and pending_long:
+            for i, r in enumerate(self._queue):
+                if len(r.prompt) <= chunk:
+                    del self._queue[i]
+                    return r
+            return None
+        return self._queue.popleft()

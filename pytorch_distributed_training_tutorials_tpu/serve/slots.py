@@ -211,6 +211,23 @@ def seed_cache(proto, segment, depth):
     return jax.tree_util.tree_map_with_path(seed, proto, segment)
 
 
+def zero_cache(proto):
+    """Zeroed batch-1 full-window cache from a shape/dtype ``proto`` —
+    the start state of a from-scratch CHUNKED prefill (ISSUE 11):
+    ``cache_index`` reads 0, so the first chunk's decode continuation
+    writes from position 0 exactly as a whole prefill would, and every
+    later chunk continues where the previous one stopped (the same
+    bitwise-equal continuation :func:`seed_cache` splices rely on, just
+    starting at depth 0)."""
+
+    def z(path, p):
+        if _leaf_name(path) == "cache_index":
+            return jnp.zeros(p.shape, jnp.int32)
+        return jnp.zeros(p.shape, p.dtype)
+
+    return jax.tree_util.tree_map_with_path(z, proto)
+
+
 def tree_nbytes(tree) -> int:
     """Total bytes of a pytree's array leaves, from shape/dtype metadata
     only — works on concrete arrays AND ``jax.eval_shape`` structs, and

@@ -36,14 +36,39 @@ from pytorch_distributed_training_tutorials_tpu.obs.histogram import (  # noqa: 
 )
 
 
-def _fmt_event(ev: dict, trigger: dict | None) -> str:
+def _fmt_event(ev: dict, trigger: dict | None, note: str = "") -> str:
     t = ev.get("t", 0.0)
     kind = ev.get("kind", "?")
     rest = ", ".join(
         f"{k}={v}" for k, v in ev.items() if k not in ("t", "kind")
     )
     mark = " <-- trigger" if trigger is not None and ev == trigger else ""
-    return f"  {t:>12.6f}s  {kind:<16s} {rest}{mark}"
+    return f"  {t:>12.6f}s  {kind:<16s} {rest}{note}{mark}"
+
+
+def _chain_annotations(events: list[dict]) -> dict[int, str]:
+    """Pipelined engines interleave chain_start/chain_end lines (chain
+    i+1 dispatches before chain i's fetch lands). The timeline stays in
+    stamp order — the overlap is real, not a rendering artifact — and
+    this pre-pass makes it legible: each chain_end is annotated with the
+    LATER chains still in flight at that moment. Keyed by event object
+    id (events are not hashable)."""
+    open_chains: set = set()
+    notes: dict[int, str] = {}
+    for ev in events:
+        c = ev.get("chain")
+        if c is None:
+            continue
+        if ev.get("kind") == "chain_start":
+            open_chains.add(c)
+        elif ev.get("kind") == "chain_end":
+            open_chains.discard(c)
+            later = sorted(x for x in open_chains if x > c)
+            if later:
+                notes[id(ev)] = " [in flight: chain " + ", ".join(
+                    str(x) for x in later
+                ) + "]"
+    return notes
 
 
 def _fmt_span(span: dict) -> str:
@@ -85,9 +110,10 @@ def render(snap: dict, index: int, max_events: int) -> None:
         )
         print(f"event counts: {line}")
     trigger = snap.get("trigger")
+    notes = _chain_annotations(snap["events"])
     print(f"\nevents (last {min(max_events, len(snap['events']))}):")
     for ev in snap["events"][-max_events:]:
-        print(_fmt_event(ev, trigger))
+        print(_fmt_event(ev, trigger, notes.get(id(ev), "")))
     if snap.get("live_spans"):
         print("\nlive requests at dump time:")
         for span in snap["live_spans"]:

@@ -289,6 +289,7 @@ def test_lora_init_and_mask_shape():
 
 
 @requires_pallas_interpret
+@pytest.mark.slow
 def test_finetune_register_serve_lifecycle():
     """The acceptance criterion end to end on the CPU mesh: fine-tune a
     tenant row through the fused logits-free loss with the optimizer

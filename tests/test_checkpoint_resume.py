@@ -1,5 +1,6 @@
 """Trainer checkpoint/resume, evaluation, and profiler tracing."""
 
+import pytest
 import glob
 import os
 
@@ -100,6 +101,7 @@ def test_train_skip_when_resumed_past_max_epochs(tmp_path):
     assert np.isnan(out["loss"])
 
 
+@pytest.mark.slow
 def test_profiler_trace_produces_artifacts(tmp_path):
     logdir = str(tmp_path / "trace")
     t = _trainer()

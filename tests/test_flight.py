@@ -308,3 +308,84 @@ def test_completion_without_span_still_counts():
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-q"]))
+
+
+# ------------------------------------------------- pipeline overlap (ISSUE 11)
+
+def test_chain_overlap_counts_every_chain():
+    """Every chain_end with a sequence number records ONE overlap
+    sample — serial chains land 0.0 in the underflow bucket, a chain
+    whose span contained a later dispatch records the overlapped
+    fraction — so the histogram count is the chain count and the
+    receipt can show how much of the roundtrip the pipeline hid."""
+    import time
+
+    rec = FlightRecorder(capacity=32)
+    # serial pair: no later chain in flight at the end stamp
+    rec.chain_start(1, 2, chain=0)
+    rec.chain_end(tokens=4, occupancy=1, chain=0)
+    # pipelined pair: chain 2 dispatches inside chain 1's span (sleeps
+    # make the sub-spans measurable on any clock; the tests assert
+    # counts and bounds, never wall-clock-dependent quantiles)
+    rec.chain_start(1, 2, chain=1)
+    time.sleep(0.002)
+    rec.chain_start(1, 2, chain=2)
+    time.sleep(0.002)
+    rec.chain_end(tokens=4, occupancy=1, chain=1)
+    rec.chain_end(tokens=4, occupancy=1, chain=2)
+    h = rec.hist["chain_overlap"]
+    assert h.n == 3                 # chains 0, 1, 2 — one sample each
+    assert h.counts[0] == 2         # the two zero-overlap chains
+    assert 0.0 < h.max_seen <= 1.0  # chain 1's overlapped fraction
+    # chain_util keeps recording independently (one sample per start)
+    assert rec.hist["chain_util"].n == 3
+
+
+def test_chain_overlap_legacy_calls_and_summary():
+    """chain_start/chain_end WITHOUT a sequence number (the pre-pipeline
+    call shape) stay valid and record no overlap sample; summary() grows
+    the chain_overlap_* family next to chain_util_*."""
+    rec = FlightRecorder()
+    rec.chain_start(1, 4)
+    rec.chain_end(tokens=8, occupancy=1)
+    assert rec.hist["chain_overlap"].n == 0
+    # an end whose start was never opened (recorder attached mid-chain)
+    # is silently skipped, not a crash or a bogus sample
+    rec.chain_end(tokens=8, occupancy=1, chain=99)
+    assert rec.hist["chain_overlap"].n == 0
+    rec.chain_start(1, 4, chain=0)
+    rec.chain_end(tokens=8, occupancy=1, chain=0)
+    s = rec.summary()
+    assert s["chain_overlap_count"] == 1
+    assert s["chain_overlap_max"] == 0.0  # serial run: zero overlap mass
+    assert {"chain_overlap_mean", "chain_overlap_p50",
+            "chain_overlap_p95"} <= set(s)
+    assert all(isinstance(v, (int, float)) for v in s.values())
+
+
+def test_flight_view_annotates_overlapped_chains(tmp_path):
+    """scripts/flight_view.py renders a pipelined dump with each
+    overlapped chain_end annotated by the later chains still in flight
+    at that stamp — the timeline stays in stamp order, the annotation
+    makes the interleave legible."""
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(capacity=32, dump_path=path)
+    rec.chain_start(1, 2, chain=0)
+    rec.chain_start(1, 2, chain=1)   # in flight before chain 0 ends
+    rec.chain_end(tokens=4, occupancy=1, chain=0)
+    rec.chain_end(tokens=4, occupancy=1, chain=1)
+    rec.dump(reason="end_of_stream")
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "flight_view.py"), path],
+        capture_output=True, text=True, timeout=120, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "[in flight: chain 1]" in out.stdout
+    # chain 1's own end has nothing later in flight — no annotation
+    last_end = [ln for ln in out.stdout.splitlines()
+                if "chain_end" in ln and "chain=1" in ln]
+    assert last_end and all("in flight" not in ln for ln in last_end)

@@ -86,6 +86,7 @@ def _single_device_accum_step(model, variables, xs, ys, lr):
     )
 
 
+@pytest.mark.slow
 def test_gpipe_resnet18_matches_single_device(dp_pp_mesh):
     """dp(4) x pp(2), 4 microbatches: params, BN stats, and loss after one
     GPipe step equal the single-device gradient-accumulation step."""
@@ -166,6 +167,7 @@ def test_gpipe_shard_shapes_and_placement(dp_pp_mesh):
     assert sum(pipe.stage_param_counts()) == total
 
 
+@pytest.mark.slow
 def test_gpipe_trains(dp_pp_mesh):
     model = resnet18(num_classes=10, stem="cifar")
     x, y = _tiny_images(n=32, seed=1)
@@ -202,6 +204,7 @@ def _lm_cfg(**kw):
     return TransformerConfig(**base)
 
 
+@pytest.mark.slow
 def test_spmd_pipeline_forward_and_grads_match_unpipelined(dp_pp_mesh):
     """The GPipe schedule reorders compute, not math: logits and grads are
     identical to the plain scan-layers TransformerLM."""
@@ -276,6 +279,7 @@ def test_spmd_pipeline_rejects_bad_configs(dp_pp_mesh):
         )
 
 
+@pytest.mark.slow
 def test_gpipe_dispatch_count_scales_with_microbatches(dp_pp_mesh):
     """Pin GPipe's dispatch model: the heterogeneous schedule is
     PYTHON-DRIVEN — train_step issues exactly n_stages*m forward and

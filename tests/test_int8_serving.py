@@ -161,6 +161,7 @@ def test_load_quantized_lm_scan_layers_checkpoint(tmp_path):
     "slightly different logits; only bitwise-equal logits would close it",
     strict=False,
 )
+@pytest.mark.slow
 def test_tp_quantized_serving_matches_replicated():
     """The C13 finish line: a quantized LM sharded dp x tp over the mesh
     must generate the same greedy tokens as replicated int8 serving, with
@@ -352,6 +353,7 @@ def test_quantize_accepts_frozendict():
     )
 
 
+@pytest.mark.slow
 def test_bf16_kv_cache_serving():
     """kv_cache_dtype=bf16 halves cache bytes (long-window decode is
     cache-traffic-bound — DECODE_r04.md). Opt-in because stored K/V are

@@ -1,5 +1,6 @@
 """MoE FFN: routing correctness, expert-parallel sharding, e2e training."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -102,6 +103,7 @@ def test_moe_aux_loss_survives_scan_layers():
     assert total >= 3.0 - 1e-4  # one >= 1.0 aux term per scanned layer
 
 
+@pytest.mark.slow
 def test_expert_parallel_sharding_and_training():
     """dp x ep mesh: expert weights shard over 'expert'; training converges."""
     mesh = create_mesh({"data": 2, "expert": 4})
@@ -124,6 +126,7 @@ def test_expert_parallel_sharding_and_training():
     assert last["loss"] < first["loss"]
 
 
+@pytest.mark.slow
 def test_ep_matches_single_device():
     """One dp x ep step == one single-device step: EP is layout, not model."""
     cfg = TransformerConfig(

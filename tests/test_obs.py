@@ -237,6 +237,7 @@ def _assert_report_conserves(report: StepReport, logdir: str) -> None:
     assert report.total_us + report.wrapper_us == pytest.approx(raw_total)
 
 
+@pytest.mark.slow
 def test_step_report_real_resnet_step_trace(tmp_path):
     """PROFILE_r04-as-a-library-call, pinned on a real (CPU-mesh) ResNet
     train-step trace: >= 90% of device time in named categories, the conv

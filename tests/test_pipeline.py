@@ -97,6 +97,7 @@ def test_toy_train_step_matches_single_device():
     )
 
 
+@pytest.mark.slow
 def test_resnet_pipeline_param_split_and_training():
     """The ResNet-50-style 2-stage split (here ResNet-18 for CPU speed):
     params partition without overlap, both stages train, BN stats update."""

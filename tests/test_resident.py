@@ -82,6 +82,7 @@ def test_scanned_epoch_matches_streaming_numerics(mesh):
         np.testing.assert_allclose(np.asarray(ls), np.asarray(lr), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_transform_applied_on_device(mesh):
     """uint8 storage + on-device normalize: the HBM-friendly image path."""
     from pytorch_distributed_training_tutorials_tpu.data.datasets import ArrayDataset
@@ -127,6 +128,7 @@ def test_resident_rejects_batch_spec(mesh):
         )
 
 
+@pytest.mark.slow
 def test_loss_decreases_resident_mnist(mesh):
     ds = mnist("train")
     # 512 samples, downsampled 28x28 -> 7x7: XLA:CPU conv compile time

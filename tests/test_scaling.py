@@ -2,6 +2,7 @@
 
 import numpy as np
 import optax
+import pytest
 
 from pytorch_distributed_training_tutorials_tpu.bench.scaling import report, sweep
 from pytorch_distributed_training_tutorials_tpu.models import MLP
@@ -85,6 +86,7 @@ HloModule m
     assert out["all-reduce"]["bytes"] == 1024 * 4
 
 
+@pytest.mark.slow
 def test_collective_stats_matches_grad_bytes():
     """The compiled DDP step's all-reduce payload must equal the f32
     gradient bytes (plus small BN-stat/loss reductions) and be

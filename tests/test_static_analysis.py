@@ -344,6 +344,44 @@ def test_host_sync_fires_inside_jit():
     assert [f.line for f in found] == [7, 8, 9]
 
 
+def test_host_sync_pipelined_chain_fetch_contract():
+    """The ISSUE 11 foot-gun pair: fetching a chain result INSIDE the
+    compiled chain (peeking at logits mid-trace) fires host-sync-hazard
+    — it would force a device sync per launch and defeat the pipeline —
+    while the double-buffered engine idiom (dispatch chain i+1, THEN
+    ``jax.device_get`` chain i's retained output, both at host level)
+    stays silent."""
+    bad = """
+        import jax
+
+        @jax.jit
+        def chain(state):
+            out = state + 1
+            peek = jax.device_get(out)      # fetch inside the chain!
+            return out, peek
+    """
+    found = hits(check(bad), "host-sync-hazard")
+    assert [f.line for f in found] == [7]
+
+    clean = """
+        import jax
+
+        @jax.jit
+        def chain(state):
+            return state + 1, state * 2
+
+        def pump(state, inflight, depth):
+            # dispatch chain i+1 BEFORE fetching chain i — the fetch of
+            # an in-flight result happens outside any traced body
+            state, out = chain(state)
+            inflight.append(out)
+            if len(inflight) > depth - 1:
+                return state, jax.device_get(inflight.pop(0))
+            return state, None
+    """
+    assert not hits(check(clean), "host-sync-hazard")
+
+
 def test_host_sync_silent_outside_jit():
     src = """
         import time

@@ -66,6 +66,7 @@ def test_chunk_sharding_layout():
     assert {s.data.shape for s in x.addressable_shards} == {(4, 4, 16)}
 
 
+@pytest.mark.slow
 def test_chunked_training_identical_to_per_step():
     """The chunk scan is a re-batching of the same steps: final params must
     match the per-step streaming path bit-for-bit (same seeds)."""

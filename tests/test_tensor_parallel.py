@@ -76,6 +76,7 @@ def test_params_actually_sharded():
     "rather than masked by a loosened tolerance",
     strict=False,
 )
+@pytest.mark.slow
 def test_tp_matches_single_device_training():
     """One DP x TP train step == one single-device step (same init seed):
     the Megatron split is an implementation detail, not a model change."""

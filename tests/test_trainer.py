@@ -6,6 +6,7 @@ reference's observable semantics hold (steps math, replicated params, grad
 sync equivalence to single-device large-batch training).
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -97,6 +98,7 @@ def test_spmd_step_equals_single_device_large_batch():
     np.testing.assert_allclose(float(m["loss"]), float(m1["loss"]), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_resnet_train_step_with_batch_stats():
     """BN models: batch_stats threads through the jitted step under sharding."""
     import optax
@@ -209,6 +211,7 @@ def test_grad_accum_matches_full_batch():
     )
 
 
+@pytest.mark.slow
 def test_grad_accum_with_batch_stats_runs():
     from pytorch_distributed_training_tutorials_tpu.models import resnet18
     from pytorch_distributed_training_tutorials_tpu.train.trainer import (
