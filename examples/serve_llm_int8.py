@@ -311,6 +311,29 @@ def main():
         "inject between chains",
     )
     ap.add_argument(
+        "--replicas", type=int, default=1,
+        help="for --server: serve through a FleetRouter over N replica "
+        "engines (N KV-cache footprints in HBM — the same checkpoint "
+        "params are shared). 1 (default) keeps the plain single-engine "
+        "arm byte-for-byte; >1 adds fleet receipt fields (exactly-once "
+        "ledger, health states, merged flight histograms)",
+    )
+    ap.add_argument(
+        "--qps", type=float, default=0.0,
+        help="for --server --replicas: offered load in requests/s — an "
+        "OPEN-loop Poisson arrival process (seeded exponential "
+        "inter-arrivals; QueueFull arrivals are shed and counted, the "
+        "honest overload behavior). 0 (default) submits the whole "
+        "stream up front (the closed-loop burst the single-engine arm "
+        "uses)",
+    )
+    ap.add_argument(
+        "--hedge-after", type=float, default=None, dest="hedge_after",
+        help="for --server --replicas: duplicate a request stuck on a "
+        "SUSPECT replica after this many seconds (first completion "
+        "wins, the loser is cancelled and absorbed); default off",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -495,7 +518,10 @@ def main():
     # ~19 s tunnel stall would otherwise be charged to compile_s)
     int(jnp.zeros((), jnp.int32) + 1)
     if args.server:
-        serve_request_stream(args, cfg, lm, params, receipt)
+        if args.replicas > 1:
+            serve_fleet_stream(args, cfg, lm, params, receipt)
+        else:
+            serve_request_stream(args, cfg, lm, params, receipt)
         if args.json:
             from pytorch_distributed_training_tutorials_tpu.obs import (
                 make_receipt,
@@ -569,6 +595,223 @@ def main():
         # with every SERVING_rXX.json so receipts stay self-describing
         write_receipt(args.json, make_receipt("serving", receipt))
         print(f"receipt -> {args.json}")
+
+
+def _reset_serving_counters(engine) -> None:
+    """Zero the engine's traffic counters after the compile warmup so
+    the timed stream's receipt measures serving, not tracing."""
+    engine.n_chains = engine.n_prefills = engine.generated_tokens = 0
+    engine.n_splices = engine.prefix_hit_tokens = 0
+    engine.n_verify_forwards = engine.spec_steps_consumed = 0
+    engine.spec_drafts_accepted = 0
+    engine.adapter_requests = 0
+    engine.n_deadline_expired = engine.n_cancelled = 0
+    engine.nonfinite_quarantined = engine.n_prefill_errors = 0
+    engine.n_chunks = 0
+    if engine.prefix is not None:
+        engine.prefix.hits = engine.prefix.misses = 0
+
+
+def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
+    """The ``--server --replicas N`` leg (ISSUE 12): the same request
+    stream through a :class:`...serve.FleetRouter` over N replica
+    engines sharing one checkpoint's params (N KV-cache footprints in
+    HBM — tenants-per-chip economics, but for whole replicas).
+
+    ``--qps`` makes the stream OPEN loop: Poisson arrivals from a
+    seeded exponential inter-arrival process, submitted at their
+    arrival instants regardless of completion progress; a ``QueueFull``
+    arrival (every replica saturated) is SHED and counted — the honest
+    overload behavior, vs a closed loop that politely self-throttles.
+    ``--qps 0`` submits everything up front (the single-engine arm's
+    burst).
+
+    Every replica carries its own flight recorder on ONE shared t0, so
+    the receipt's percentiles come from the bucket-wise MERGED
+    histograms (``FleetRouter.stats``) — summing per-replica p95s would
+    be meaningless — and ``--flight-log`` writes the merged
+    ``graft-flightlog/v1`` snapshot (``dump_fleet``), which
+    scripts/flight_view.py renders with ``replica=i`` tags and
+    ``[dead]``/``[draining]`` health annotations."""
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_training_tutorials_tpu.obs import FlightRecorder
+    from pytorch_distributed_training_tutorials_tpu.serve import (
+        FleetRouter,
+        QueueFull,
+        Request,
+        ServeEngine,
+    )
+
+    window = int(cfg.max_seq_len)
+    new = args.new_tokens
+    lengths = sorted(
+        {
+            max(1, args.prompt_len // 2),
+            min(args.prompt_len, window - new),
+            min(args.prompt_len + args.prompt_len // 2, window - new),
+        }
+    )
+    cache_mb = args.prefix_cache_mb
+    if cache_mb is None:
+        cache_mb = 512 if args.prefix_overlap > 0 else 0
+
+    def mk_bank():
+        # per-replica banks with IDENTICAL tenants (deterministic
+        # seeds), so a re-dispatched tenant request decodes under the
+        # same factors wherever it lands
+        if not args.adapters:
+            return None
+        from pytorch_distributed_training_tutorials_tpu.adapters import AdapterBank
+
+        bank = AdapterBank(lm, n_adapters=args.adapters,
+                           rank=args.lora_rank)
+        frng = np.random.Generator(np.random.PCG64(13))
+        for aid in range(1, args.adapters):
+            bank.register(
+                f"tenant-{aid}",
+                jax.tree_util.tree_map(
+                    lambda leaf: (
+                        frng.standard_normal(leaf.shape) * 0.02
+                    ).astype(np.float32),
+                    bank.row_zeros(),
+                ),
+            )
+        return bank
+
+    t0 = time.perf_counter()
+    engines = [
+        ServeEngine(
+            lm, params,
+            n_slots=args.slots,
+            tokens_per_launch=args.tokens_per_launch,
+            max_queue=max(64, args.requests),
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            prefix_cache_bytes=cache_mb * 1024 * 1024,
+            speculative_k=args.spec_k,
+            spec_ngram=args.spec_ngram,
+            adapter_bank=mk_bank(),
+            default_deadline_s=args.deadline_s,
+            pipeline_depth=args.pipeline_depth,
+            prefill_chunk=args.prefill_chunk,
+            flight=FlightRecorder(capacity=4096, t0=t0),
+        )
+        for _ in range(args.replicas)
+    ]
+    router = FleetRouter(
+        engines,
+        hedge_after_s=args.hedge_after,
+        flight=FlightRecorder(capacity=4096, t0=t0),
+    )
+    rng = np.random.Generator(np.random.PCG64(11))
+    shared = rng.integers(0, cfg.vocab_size, (max(lengths),)).tolist()
+
+    def mk_request(i: int, deadline_s: float | None = None) -> Request:
+        p_len = lengths[i % len(lengths)]
+        k = min(p_len, int(round(args.prefix_overlap * p_len)))
+        tail = rng.integers(0, cfg.vocab_size, (p_len - k,)).tolist()
+        return Request(
+            prompt=shared[:k] + tail, max_new_tokens=new, seed=i,
+            deadline_s=deadline_s,
+            adapter=(i % args.adapters) if args.adapters else 0,
+        )
+
+    # compile warmup: the replicas share one set of jitted programs ONLY
+    # per engine object, so every replica prefills each prompt bucket
+    # once before the timed stream (same compile/serve split as the
+    # single-engine arm, N times over)
+    t_compile = time.perf_counter()
+    for eng in engines:
+        for i in range(len(lengths)):
+            eng.submit(mk_request(
+                i, deadline_s=1e9 if args.deadline_s is not None else None,
+            ))
+        eng.run_until_idle()
+    compile_s = time.perf_counter() - t_compile
+    for eng in engines:
+        _reset_serving_counters(eng)
+        eng._flight.reset()
+    router._flight.reset()
+
+    # open-loop Poisson arrivals (qps > 0) or the up-front burst (0)
+    arng = np.random.Generator(np.random.PCG64(17))
+    t_arr = 0.0
+    arrivals = []
+    for _ in range(args.requests):
+        if args.qps > 0:
+            t_arr += float(arng.exponential(1.0 / args.qps))
+        arrivals.append(t_arr)
+
+    shed = 0
+    next_i = 0
+    t_start = time.perf_counter()
+    while next_i < len(arrivals):
+        due = t_start + arrivals[next_i]
+        if time.perf_counter() >= due:
+            try:
+                router.submit(mk_request(len(lengths) + next_i))
+            except QueueFull:
+                shed += 1  # overload: shed at the door, keep serving
+            next_i += 1
+            continue
+        if router.idle:
+            time.sleep(min(0.001, max(0.0, due - time.perf_counter())))
+        else:
+            router.step()
+    router.run_until_idle()
+    for eng in engines:
+        # close the timed region with a real fetch per replica
+        jax.device_get(eng._state["remaining"])
+    wall_s = time.perf_counter() - t_start
+
+    rstats = router.stats()
+    toks = sum(e.generated_tokens for e in engines)
+    receipt.update(
+        server=True,
+        server_requests=args.requests,
+        server_slots=args.slots,
+        tokens_per_launch=args.tokens_per_launch,
+        server_prompt_lengths=lengths,
+        new_tokens=new,
+        max_seq_len=window,
+        temperature=args.temperature,
+        qps=args.qps,
+        server_shed=shed,
+        server_wall_s=round(wall_s, 2),
+        server_tok_per_s=round(toks / wall_s, 1),
+        server_generated_tokens=toks,
+        server_chains=sum(e.n_chains for e in engines),
+        server_prefills=sum(e.n_prefills for e in engines),
+        server_p50_latency_s=round(rstats.get("e2e_p50_s", 0.0), 3),
+        server_p95_latency_s=round(rstats.get("e2e_p95_s", 0.0), 3),
+        server_ttft_p50_s=round(rstats.get("ttft_p50_s", 0.0), 3),
+        server_ttft_p95_s=round(rstats.get("ttft_p95_s", 0.0), 3),
+        server_compile_s=round(compile_s, 1),
+        prefix_overlap=args.prefix_overlap,
+        prefix_cache_mb=cache_mb,
+        **rstats,
+        backend=jax.default_backend(),
+    )
+    ledger_problems = router.ledger.verify()
+    receipt["ledger_ok"] = not ledger_problems
+    if ledger_problems:
+        receipt["ledger_problems"] = ledger_problems
+    if args.flight_log:
+        router.dump_fleet(args.flight_log, reason="end_of_stream")
+        print(f"fleet flight log -> {args.flight_log}")
+    print(
+        f"fleet: {args.requests} requests over {args.replicas} replicas "
+        f"x {args.slots} slots in {wall_s:.2f}s — {toks / wall_s:.1f} "
+        f"tok/s aggregate, qps {args.qps or 'burst'} ({shed} shed), "
+        f"p95 {receipt['server_p95_latency_s']}s, ttft p95 "
+        f"{receipt['server_ttft_p95_s']}s, states "
+        f"{router.replica_states()}, {rstats['redispatched']} "
+        f"re-dispatched, {rstats['hedged']} hedged "
+        f"(compile {compile_s:.0f}s)"
+    )
 
 
 def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
@@ -688,16 +931,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         ))
     engine.run_until_idle()
     compile_s = time.perf_counter() - t0
-    engine.n_chains = engine.n_prefills = engine.generated_tokens = 0
-    engine.n_splices = engine.prefix_hit_tokens = 0
-    engine.n_verify_forwards = engine.spec_steps_consumed = 0
-    engine.spec_drafts_accepted = 0
-    engine.adapter_requests = 0
-    engine.n_deadline_expired = engine.n_cancelled = 0
-    engine.nonfinite_quarantined = engine.n_prefill_errors = 0
-    engine.n_chunks = 0
-    if engine.prefix is not None:
-        engine.prefix.hits = engine.prefix.misses = 0
+    _reset_serving_counters(engine)
     # the warmup's compile-dominated spans would poison the percentile
     # histograms — reset the recorder with the counters above
     flight.reset()

@@ -106,6 +106,14 @@ CONFIG_FIELDS = (
     # different experiments; n_chunks stays out — an outcome of the
     # traffic mix, not configuration
     "pipeline_depth", "prefill_chunk",
+    # fleet router (ISSUE 12): replica count, hedging delay, affinity
+    # depth, and the offered load change what an aggregate tok/s or
+    # tail-latency number MEANS, so fleet rounds and single-engine
+    # rounds are different experiments; the health/ledger counters
+    # (replicas_dead, redispatched, hedged, probes, ...) stay out
+    # deliberately — outcomes of the injected faults and traffic, not
+    # configuration of the experiment
+    "n_replicas", "hedge", "affinity", "qps",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

@@ -58,6 +58,8 @@ _LAZY_EXPORTS = {
     "FLIGHT_SCHEMA": "pytorch_distributed_training_tutorials_tpu.obs.flight",
     "FlightRecorder": "pytorch_distributed_training_tutorials_tpu.obs.flight",
     "load_flightlog": "pytorch_distributed_training_tutorials_tpu.obs.flight",
+    "merge_snapshots": "pytorch_distributed_training_tutorials_tpu.obs.flight",
+    "summarize_merged": "pytorch_distributed_training_tutorials_tpu.obs.flight",
     "validate_flightlog": "pytorch_distributed_training_tutorials_tpu.obs.flight",
     "LogHistogram": "pytorch_distributed_training_tutorials_tpu.obs.histogram",
 }

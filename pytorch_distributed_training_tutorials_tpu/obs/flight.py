@@ -70,6 +70,9 @@ EVENT_KINDS = frozenset({
     "step_skipped",      # trainer nonfinite skip (rides the batched fetch)
     "rollback",          # trainer loss-spike rollback fired
     "stall",             # injected launch stall (utils/chaos.py)
+    "replica_health",    # fleet router health transition (ISSUE 12)
+    "redispatch",        # router moved a request off a dead/draining replica
+    "hedge",             # router duplicated a straggler onto a second replica
 })
 
 # Faults trigger an auto-dump when a dump_path is configured.
@@ -88,20 +91,31 @@ class FlightRecorder:
     dump_events: how many trailing events a snapshot carries.
     max_done_spans: completed-span retention (histograms already hold
         the aggregate; the deque is for post-mortem context only).
+    t0: epoch for the relative timestamps (a ``time.perf_counter()``
+        reading). Defaults to construction time; a FLEET passes ONE
+        shared ``t0`` to every replica's recorder (and the router's) so
+        :func:`merge_snapshots` can interleave their events on a common
+        timeline — recorders with private epochs merge fine but sort
+        per-recorder-relative.
     """
 
     def __init__(self, capacity: int = 1024,
                  dump_path: Optional[str] = None,
                  dump_events: int = 64,
-                 max_done_spans: int = 256):
+                 max_done_spans: int = 256,
+                 t0: Optional[float] = None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = int(capacity)
         self.dump_path = dump_path
         self.dump_events = int(dump_events)
         self.max_done_spans = int(max_done_spans)
-        self._t0 = time.perf_counter()
+        self._t0 = time.perf_counter() if t0 is None else float(t0)
         self.reset()
+
+    @property
+    def t0(self) -> float:
+        return self._t0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -367,6 +381,111 @@ class FlightRecorder:
             k: (round(v, 6) if isinstance(v, float) else v)
             for k, v in out.items()
         }
+
+
+# -- fleet merge (serve/router.py, ISSUE 12) -------------------------------
+
+def _merged_histograms(snaps: List[dict]) -> Dict[str, LogHistogram]:
+    """Bucket-wise merge of every snapshot's histogram states, keyed by
+    name. All recorders build the same geometry per name, so
+    :meth:`..obs.histogram.LogHistogram.merge` applies directly — the
+    merged counts are EXACTLY what one recorder observing all the
+    traffic would hold; this is the mergeability LogHistogram was built
+    for."""
+    hists: Dict[str, LogHistogram] = {}
+    for snap in snaps:
+        for name, state in snap.get("histograms", {}).items():
+            h = LogHistogram.from_dict(state)
+            if name in hists:
+                hists[name].merge(h)
+            else:
+                hists[name] = h
+    return hists
+
+
+def merge_snapshots(tagged: List[tuple], reason: str = "fleet") -> dict:
+    """Merge N recorders' snapshots into ONE ``graft-flightlog/v1``
+    snapshot: events and spans gain a ``replica`` tag (the caller's —
+    an int index or "router"), events interleave by timestamp (pass one
+    shared ``t0`` to every recorder for a common timeline), counts and
+    totals sum, histograms merge bucket-wise. The result validates and
+    renders exactly like a single-recorder dump, so
+    ``scripts/flight_view.py`` needs no fleet mode — only the
+    ``replica=`` field and health annotations."""
+    events: List[dict] = []
+    live: List[dict] = []
+    done: List[dict] = []
+    counts: Counter = Counter()
+    n_events = 0
+    dropped = 0
+    t = 0.0
+    for tag, snap in tagged:
+        validate_flightlog(snap)
+        for ev in snap["events"]:
+            merged_ev = dict(ev)
+            merged_ev.setdefault("replica", tag)
+            events.append(merged_ev)
+        for span in snap["live_spans"]:
+            live.append({**span, "replica": tag})
+        for span in snap["done_spans"]:
+            done.append({**span, "replica": tag})
+        counts.update(snap.get("counts", {}))
+        n_events += snap.get("n_events", 0)
+        dropped += snap.get("dropped", 0)
+        t = max(t, snap.get("t", 0.0))
+    events.sort(key=lambda e: e.get("t", 0.0))
+    hists = _merged_histograms([snap for _, snap in tagged])
+    return {
+        "schema": FLIGHT_SCHEMA,
+        "reason": reason,
+        "t": t,
+        "trigger": None,
+        "events": events,
+        "live_spans": live,
+        "done_spans": done,
+        "histograms": {k: h.to_dict() for k, h in hists.items()},
+        "counts": dict(counts),
+        "n_events": n_events,
+        "dropped": dropped,
+    }
+
+
+def summarize_merged(snaps: List[dict]) -> dict:
+    """The receipt-grade aggregate over N snapshots — same keys as
+    :meth:`FlightRecorder.summary` so a fleet receipt drops into the
+    slots a single-engine receipt used, but the percentile fields come
+    from the MERGED histograms (averaging or summing per-replica p95s
+    would be statistically meaningless)."""
+    hists = _merged_histograms(snaps)
+    out = {
+        "flight": 1,
+        "flight_events": sum(s.get("n_events", 0) for s in snaps),
+        "flight_dropped": sum(s.get("dropped", 0) for s in snaps),
+        "flight_faults": sum(
+            s.get("counts", {}).get(k, 0)
+            for s in snaps for k in _AUTO_DUMP_KINDS
+        ),
+        "flight_spans_live": sum(len(s["live_spans"]) for s in snaps),
+        "flight_spans_done": sum(len(s["done_spans"]) for s in snaps),
+    }
+    prefixes = {
+        "ttft": ("ttft_", "s"), "e2e": ("e2e_", "s"),
+        "queue_wait": ("queue_wait_", "s"),
+        "chain_util": ("chain_util_", None),
+        "chain_overlap": ("chain_overlap_", None),
+    }
+    for name, (prefix, unit) in prefixes.items():
+        h = hists.get(name)
+        if h is None:
+            continue
+        if unit is None:
+            out.update(h.summary(prefix=prefix))
+        else:
+            out.update(h.summary(prefix=prefix, unit=unit))
+    return {
+        k: (round(v, 6) if isinstance(v, float) else v)
+        for k, v in out.items()
+    }
 
 
 # -- dump-file tooling (scripts/flight_view.py + tests) --------------------

@@ -17,7 +17,12 @@ Public surface:
 - :class:`.prefix.PrefixIndex` / :class:`.prefix.Segment` — the
   jax-free radix prefix index behind ``ServeEngine(prefix_cache_bytes=
   ...)``: shared-prompt KV reuse via retained cache segments
-  (longest-prefix-match, refcount pinning, LRU byte budget).
+  (longest-prefix-match, refcount pinning, LRU byte budget);
+- :class:`.router.FleetRouter` / :class:`.router.DispatchLedger` /
+  :func:`.router.affinity_hash` — the jax-free multi-replica fleet
+  front door (ISSUE 12): replica health states with a circuit breaker,
+  exactly-once re-dispatch off dead/draining replicas, hedged
+  stragglers, prefix-affinity routing, merged fleet receipts.
 
 ``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` runs the end-to-end smoke
 (token-exactness vs ``generate()`` included) and prints one receipt line
@@ -35,6 +40,9 @@ import importlib
 # name -> submodule; resolved on first access via __getattr__.
 _LAZY_EXPORTS = {
     "ServeEngine": "pytorch_distributed_training_tutorials_tpu.serve.engine",
+    "DispatchLedger": "pytorch_distributed_training_tutorials_tpu.serve.router",
+    "FleetRouter": "pytorch_distributed_training_tutorials_tpu.serve.router",
+    "affinity_hash": "pytorch_distributed_training_tutorials_tpu.serve.router",
     "PrefixIndex": "pytorch_distributed_training_tutorials_tpu.serve.prefix",
     "Segment": "pytorch_distributed_training_tutorials_tpu.serve.prefix",
     "Completion": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",

@@ -54,8 +54,16 @@ engine (double-buffering moves the fetch off the critical path, never
 changes what was computed), the fetch budget is unchanged (mid-prefill
 chunks are pure dispatch — no fetch until the final chunk), and the
 chunking mechanism must have fired (``n_chunks > 0`` on a stream whose
-longest prompt exceeds the chunk). Prints exactly one JSON line (a
-``graft-receipt/v1`` envelope) and exits non-zero on any failure.
+longest prompt exceeds the chunk). An eighth (``--router``) arm runs a
+3-replica fleet of real engines behind :class:`..serve.FleetRouter`
+(ISSUE 12): a fault-free leg must be byte-identical to the single
+engine (routing is invisible), then the same stream replays with one
+replica chaos-killed mid-stream — the DispatchLedger must verify
+exactly-once (no accepted request lost or completed twice),
+re-dispatched requests must stay byte-identical to the fault-free leg,
+and the SUMMED per-replica fetch budget stays chains + prefills +
+splices. Prints exactly one JSON line (a ``graft-receipt/v1``
+envelope) and exits non-zero on any failure.
 """
 
 from __future__ import annotations
@@ -68,7 +76,8 @@ import sys
 
 def selftest(json_path: str | None = None, spec_k: int = 2,
              adapters: int = 3, chaos: bool = False,
-             flight: bool = False, pipeline: bool = False) -> dict:
+             flight: bool = False, pipeline: bool = False,
+             router: bool = False) -> dict:
     import math
     import tempfile
 
@@ -636,6 +645,141 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
         }
 
     # ------------------------------------------------------------------
+    # router arm (--router, ISSUE 12): a 3-replica fleet of REAL engines
+    # behind the FleetRouter. Leg 1 (fault-free) pins fleet == single
+    # engine: every request's greedy tokens byte-identical to the base
+    # arm's. Leg 2 re-runs the same stream with a chaos-killed replica
+    # mid-stream: the DispatchLedger must verify exactly-once (no
+    # accepted request lost or completed twice), every request that
+    # still finished "length" must be byte-identical to the fault-free
+    # run (re-dispatch is invisible in outputs — same template, same
+    # seed), and the summed per-replica fetch budget stays exactly
+    # chains + prefills + splices. The fleet flight summary (merged
+    # histograms, shared t0) rides into the receipt.
+    # ------------------------------------------------------------------
+    router_fields: dict = {}
+    if router:
+        import time as _time
+
+        from pytorch_distributed_training_tutorials_tpu.obs import FlightRecorder
+        from pytorch_distributed_training_tutorials_tpu.serve import FleetRouter, affinity_hash
+        from pytorch_distributed_training_tutorials_tpu.utils.chaos import FleetChaosConfig
+
+        n_replicas = 3
+        # the base stream plus two same-prompt clones of request 0, so
+        # the kill target (request 0's affine replica) is guaranteed to
+        # hold BOTH in-flight and queued work when it dies
+        fleet_stream = list(prompts) + [prompts[0], prompts[0]]
+        expected_gid = {g: completions[g].tokens for g in range(len(prompts))}
+        expected_gid[len(prompts)] = completions[0].tokens
+        expected_gid[len(prompts) + 1] = completions[0].tokens
+        kill_target = affinity_hash(prompts[0][0], adapter=0,
+                                    depth=16) % n_replicas
+
+        def run_fleet(fleet_chaos):
+            t0 = _time.perf_counter()
+            engines = [
+                ServeEngine(
+                    model, params, n_slots=1, tokens_per_launch=4,
+                    max_queue=8,
+                    flight=FlightRecorder(capacity=256, t0=t0),
+                )
+                for _ in range(n_replicas)
+            ]
+            fr = FleetRouter(
+                engines, chaos=fleet_chaos,
+                flight=FlightRecorder(capacity=256, t0=t0),
+            )
+            count = {"n": 0}
+
+            def counting(x):
+                count["n"] += 1
+                return real_get(x)
+
+            jax.device_get = counting
+            try:
+                out = {}
+                for toks, max_new in fleet_stream:
+                    fr.submit(Request(prompt=toks, max_new_tokens=max_new))
+                for c in fr.run_until_idle():
+                    out[c.request_id] = c
+            finally:
+                jax.device_get = real_get
+            return fr, engines, out, count["n"]
+
+        # leg 1: fault-free fleet — byte-identical to the single engine
+        fr_ok, eng_ok, out_ok, fetches_ok = run_fleet(None)
+        fleet_exact = all(
+            out_ok[g].tokens == expected_gid[g]
+            and out_ok[g].finish_reason == "length"
+            for g in expected_gid
+        )
+        if len(out_ok) != len(fleet_stream) or not fleet_exact:
+            problems.append(
+                f"fault-free fleet diverged from the single engine: "
+                f"{[(g, c.finish_reason) for g, c in sorted(out_ok.items())]}"
+            )
+        ledger_ok = fr_ok.ledger.verify()
+        if ledger_ok:
+            problems.append(f"fault-free fleet ledger: {ledger_ok}")
+
+        # leg 2: same stream, one replica chaos-killed mid-stream
+        fr_x, eng_x2, out_x, fetches_x = run_fleet(FleetChaosConfig(
+            kill_replica=kill_target, kill_at_chain=2,
+        ))
+        if len(out_x) != len(fleet_stream):
+            problems.append(
+                f"chaos fleet: {len(out_x)} completions for "
+                f"{len(fleet_stream)} accepted requests"
+            )
+        ledger_x = fr_x.ledger.verify()
+        if ledger_x:
+            problems.append(f"chaos fleet ledger: {ledger_x}")
+        if fr_x.replica_states()[kill_target] != "dead":
+            problems.append(
+                f"killed replica {kill_target} is "
+                f"{fr_x.replica_states()[kill_target]!r}, expected dead"
+            )
+        moved = fr_x.ledger.n_redispatched + fr_x.n_dead_completions
+        if moved < 1:
+            problems.append(
+                "chaos fleet: the killed replica held no work — the "
+                "re-dispatch path never fired"
+            )
+        router_exact = all(
+            c.tokens == expected_gid[g]
+            for g, c in out_x.items()
+            if c.finish_reason in ("length", "eos")
+        )
+        if not router_exact:
+            problems.append(
+                "chaos fleet: a re-dispatched request's tokens diverged "
+                "from the fault-free run"
+            )
+        # summed per-replica fetch budget: the killed engine's counters
+        # freeze at the kill (the router never steps it again)
+        fleet_budget = sum(
+            e.n_chains + e.n_prefills + e.n_splices for e in eng_x2
+        )
+        if fetches_x > fleet_budget:
+            problems.append(
+                f"chaos fleet: {fetches_x} host fetches > {fleet_budget} "
+                f"(sum of per-replica chains + prefills + splices)"
+            )
+        rstats = fr_x.stats()
+        if (fr_x.fleet_flight_summary() or {}).get("e2e_count", 0) < 1:
+            problems.append("fleet flight summary recorded no requests")
+        router_fields = {
+            "router_requests": len(fleet_stream),
+            "router_fleet_exact": fleet_exact and router_exact,
+            "router_host_fetches_ok": fetches_ok,
+            "router_host_fetches_chaos": fetches_x,
+            "router_killed_replica": kill_target,
+            **{f"router_{k}": v for k, v in rstats.items()
+               if isinstance(v, (int, float, bool))},
+        }
+
+    # ------------------------------------------------------------------
     # chaos arm (--chaos, ISSUE 9): one staggered stream exercising every
     # serving failure path — injected NaN logits (quarantine), a deadline
     # expiry, a host-side cancel, close/drain — with the fetch budget
@@ -854,6 +998,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             **astats,
             **flight_fields,
             **pipeline_fields,
+            **router_fields,
             **fault_fields,
             "problems": problems,
             "ok": not problems,
@@ -905,6 +1050,14 @@ def main(argv: list[str] | None = None) -> int:
         "chains + chunked prefill, token-identical to serial with the "
         "same fetch budget (ISSUE 11)",
     )
+    parser.add_argument(
+        "--router", action="store_true",
+        help="also run the fleet arm: 3 real-engine replicas behind "
+        "FleetRouter, fault-free parity vs the single engine, then a "
+        "chaos-killed replica mid-stream with the exactly-once ledger, "
+        "token-exact re-dispatch, and the summed per-replica fetch "
+        "budget asserted (ISSUE 12)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -925,7 +1078,8 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", "cpu")
     receipt = selftest(args.json, spec_k=args.spec_k,
                        adapters=args.adapters, chaos=args.chaos,
-                       flight=args.flight, pipeline=args.pipeline)
+                       flight=args.flight, pipeline=args.pipeline,
+                       router=args.router)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

@@ -1,0 +1,981 @@
+"""Fleet resilience: a multi-replica router with replica health,
+exactly-once re-dispatch, and hedged stragglers (ISSUE 12).
+
+The engine layer (``serve/engine.py``) hardened a SINGLE replica — slot
+quarantine, deadlines, chain-boundary cancellation. This module is the
+layer above it: a pure-host, jax-free front door over N ``ServeEngine``
+replicas that survives the failure mode dominating production serving —
+a whole *replica* dying, stalling, or poisoning itself under live
+traffic. Same mold as the scheduler/prefix/registry family: importable
+without jax (tests/test_prefix.py pins it in a subprocess), engines are
+duck-typed (the unit tests drive fakes), every decision is deterministic
+given the injected ``clock``.
+
+Four mechanisms, each with a receipt-grade invariant:
+
+- **Health states** (``healthy -> suspect -> dead -> draining``), driven
+  by observed symptoms only: chain-boundary heartbeat age (a replica
+  that is neither idle nor advancing its chain/prefill counters is
+  stalled), consecutive fault-stat deltas (a replica quarantining slot
+  after slot is poisoning itself), and ``QueueFull`` streaks (overload).
+  ``dead`` is a circuit breaker: the replica is no longer stepped and
+  receives no traffic; after ``probe_after_s`` the circuit goes
+  half-open — the NEXT submission routes to it as a probe, and a clean
+  completion closes the circuit (``healthy``) while any fault re-opens
+  it with a fresh timer.
+- **Exactly-once re-dispatch**: every accepted request gets a router
+  (global) id and a :class:`DispatchLedger` entry recording each
+  dispatch (replica, local id, kind) and the ONE delivered completion.
+  When a replica dies, its queued-but-unstarted requests re-route to
+  healthy replicas (same ``Request`` template, same seed — greedy
+  streams are byte-identical to a fault-free run) while in-flight ones
+  complete ``finish_reason="replica_dead"`` (their partial tokens died
+  with the replica). :meth:`DispatchLedger.verify` proves no accepted
+  request is ever lost or completed twice — the selftest asserts it
+  after a chaos-killed fleet run.
+- **Hedged stragglers**: a request whose ONLY live dispatch sits on a
+  ``suspect`` replica for more than ``hedge_after_s`` is duplicated onto
+  a healthy replica; the first completion wins and the loser is
+  ``cancel()``ed through the engine's existing chain-boundary path.
+  Per-seed determinism (CLAUDE.md serving invariants) makes the two
+  token streams identical, so hedging is invisible in outputs — only
+  the ledger and the ``hedge`` flight event show it happened.
+- **Prefix-affinity routing**: requests hash (:func:`affinity_hash`,
+  FNV-1a over the adapter id + the first ``affinity_depth`` prompt
+  tokens — NEVER Python ``hash()``, which is salted per process) onto a
+  replica ring, so each replica's radix prefix cache sees a coherent key
+  population; the hash is tenant-aware (adapter id is part of the key)
+  and admission walks the ring — an unhealthy or full affine replica
+  fails over to the next (``QueueFull`` spillover bumps the overload
+  streak), and a replica that cannot serve the request's adapter is
+  skipped. Only when NO replica admits does the caller get the
+  synchronous backpressure exception, preserving the engine's
+  admission-at-submit contract fleet-wide.
+
+Fleet observability: each replica keeps its own
+:class:`..obs.flight.FlightRecorder` (pass a shared ``t0`` so their
+relative timestamps are comparable) and the router stamps its OWN
+recorder with ``replica_health`` / ``redispatch`` / ``hedge`` events;
+:meth:`FleetRouter.fleet_snapshot` merges all of them into one
+``graft-flightlog/v1`` dump (events tagged ``replica=i``, histograms
+merged bucket-wise — they are mergeable by design) that
+``scripts/flight_view.py`` renders as an interleaved timeline.
+:meth:`FleetRouter.stats` merges the replicas' ``stats(parts)`` dicts
+into one fleet receipt (counters sum, config fields that agree pass
+through, flight percentiles are recomputed from the MERGED histograms —
+summing a p95 would be nonsense).
+
+Router-off parity: an N=1 router with hedging off is pure plumbing —
+global ids coincide with the single engine's local ids, completions,
+state trees, and compiled-program counts are byte-identical to driving
+the engine directly (tests/test_serve.py pins it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .scheduler import Completion, QueueClosed, QueueFull, Request
+
+# Replica health vocabulary. "dead" doubles as the circuit-breaker open
+# state; a dead replica being probed stays "dead" until the probe's
+# clean completion closes the circuit.
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+DEAD = "dead"
+DRAINING = "draining"
+HEALTH_STATES = (HEALTHY, SUSPECT, DEAD, DRAINING)
+
+# The finish_reason the router synthesizes for requests that were
+# in-flight on a replica when it died: their partial tokens died with
+# the replica's device state, so re-running them would break the
+# "tokens earned are kept" accounting — the caller resubmits.
+REPLICA_DEAD = "replica_dead"
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def affinity_hash(prompt, adapter: int = 0, depth: int = 16) -> int:
+    """Deterministic 64-bit FNV-1a over the adapter id + the first
+    ``depth`` prompt tokens. Python's builtin ``hash()`` is salted per
+    process (PYTHONHASHSEED), which would scatter a restarted router's
+    affinity and cold every replica's prefix cache — this hash is stable
+    across processes and platforms. The adapter id leads the stream so
+    two tenants sharing a prompt family land on (usually) different
+    replicas, matching the tenant-scoped prefix-cache keys."""
+    h = _FNV_OFFSET
+    for tok in (int(adapter), *(int(t) for t in prompt[:depth])):
+        h ^= tok & _MASK64
+        h = (h * _FNV_PRIME) & _MASK64
+    # Avalanche finalizer (the Murmur3 fmix64 constants): raw FNV-1a's
+    # low bits are weak — the multiply preserves bit 0, so ``h % 2``
+    # would be nothing but the XOR of token parities and a two-replica
+    # ring would split traffic by prompt parity, not prompt identity.
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & _MASK64
+    h ^= h >> 33
+    h = (h * 0xC4CEB9FE1A85EC53) & _MASK64
+    h ^= h >> 33
+    return h
+
+
+@dataclasses.dataclass
+class LedgerEntry:
+    """One accepted request's dispatch history. ``dispatches`` holds
+    ``(replica, local_rid, kind, t)`` rows — kind is "dispatch" |
+    "redispatch" | "hedge" | "probe"; ``delivered`` is the finish_reason
+    of the ONE completion handed to the caller (None while open);
+    ``absorbed`` records completions the router swallowed (hedge losers,
+    drain-path cancellations) as ``(replica, local_rid, reason)``."""
+
+    gid: int
+    dispatches: List[Tuple[int, int, str, float]] = dataclasses.field(
+        default_factory=list
+    )
+    delivered: Optional[str] = None
+    delivered_by: int = -1
+    absorbed: List[Tuple[int, int, str]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+class DispatchLedger:
+    """The exactly-once proof object. Every accepted request opens an
+    entry; every engine submission, delivered completion, and swallowed
+    completion is recorded; :meth:`verify` re-derives the invariant from
+    the records alone — no accepted request lost, none completed twice,
+    no completion from a dispatch the router never made."""
+
+    def __init__(self) -> None:
+        self.entries: Dict[int, LedgerEntry] = {}
+        self.n_redispatched = 0
+        self.n_hedged = 0
+        self.n_absorbed = 0
+
+    def accepted(self, gid: int) -> None:
+        if gid in self.entries:
+            raise ValueError(f"gid {gid} already in ledger")
+        self.entries[gid] = LedgerEntry(gid=gid)
+
+    def dispatched(self, gid: int, replica: int, local_rid: int,
+                   kind: str, t: float) -> None:
+        self.entries[gid].dispatches.append((replica, local_rid, kind, t))
+        if kind == "redispatch":
+            self.n_redispatched += 1
+        elif kind == "hedge":
+            self.n_hedged += 1
+
+    def delivered(self, gid: int, replica: int, reason: str) -> None:
+        entry = self.entries[gid]
+        if entry.delivered is not None:
+            raise ValueError(
+                f"gid {gid} delivered twice ({entry.delivered!r} then "
+                f"{reason!r}) — exactly-once violated at record time"
+            )
+        entry.delivered = reason
+        entry.delivered_by = replica
+
+    def absorbed(self, gid: int, replica: int, local_rid: int,
+                 reason: str) -> None:
+        self.entries[gid].absorbed.append((replica, local_rid, reason))
+        self.n_absorbed += 1
+
+    def open_ids(self) -> List[int]:
+        return [g for g, e in self.entries.items() if e.delivered is None]
+
+    def verify(self, final: bool = True) -> List[str]:
+        """Return the list of exactly-once violations (empty = proof
+        holds). With ``final=True`` (end of run) an undelivered entry is
+        itself a violation — an accepted request was LOST."""
+        problems: List[str] = []
+        for gid, e in sorted(self.entries.items()):
+            if not e.dispatches:
+                problems.append(f"gid {gid}: accepted but never dispatched")
+            if final and e.delivered is None:
+                problems.append(f"gid {gid}: accepted but never completed")
+            pairs = {(r, l) for r, l, _, _ in e.dispatches}
+            for r, l, reason in e.absorbed:
+                if (r, l) not in pairs:
+                    problems.append(
+                        f"gid {gid}: absorbed completion from undisp"
+                        f"atched (replica={r}, local={l}, {reason!r})"
+                    )
+            if e.delivered is not None and e.delivered_by >= 0:
+                if e.delivered != REPLICA_DEAD and not any(
+                    r == e.delivered_by for r, _, _, _ in e.dispatches
+                ):
+                    problems.append(
+                        f"gid {gid}: delivered by replica "
+                        f"{e.delivered_by} which never held a dispatch"
+                    )
+        return problems
+
+
+class _Replica:
+    """Per-replica router-side bookkeeping (the engine itself holds no
+    fleet state). ``local_gid`` maps the engine's local request ids to
+    router gids — a dispatch is LIVE while its pair is present here."""
+
+    __slots__ = (
+        "index", "engine", "state", "heartbeat", "last_sig",
+        "last_faults", "fault_streak", "queue_full_streak",
+        "dead_since", "dead_reason", "probing", "probe_gid",
+        "stall_skips", "local_gid",
+    )
+
+    def __init__(self, index: int, engine: Any):
+        self.index = index
+        self.engine = engine
+        self.state = HEALTHY
+        self.heartbeat: Optional[float] = None
+        self.last_sig: Optional[tuple] = None
+        self.last_faults = 0
+        self.fault_streak = 0
+        self.queue_full_streak = 0
+        self.dead_since: Optional[float] = None
+        self.dead_reason = ""
+        self.probing = False
+        self.probe_gid: Optional[int] = None
+        self.stall_skips = 0
+        self.local_gid: Dict[int, int] = {}
+
+    def progress_signature(self) -> tuple:
+        """Anything that moves when the replica does real work — chains,
+        prefills, splices, chunks, tokens. Observed at the chain
+        boundary (after ``step()``), so an unchanged signature on a
+        non-idle replica means a stalled launch, not a quiet one."""
+        e = self.engine
+        return (
+            getattr(e, "n_chains", 0), getattr(e, "n_prefills", 0),
+            getattr(e, "n_splices", 0), getattr(e, "n_chunks", 0),
+            getattr(e, "generated_tokens", 0),
+        )
+
+    def fault_total(self) -> int:
+        """Self-inflicted faults only: nonfinite quarantines + prefill
+        errors. Deadline expiries and cancellations are the CALLER's
+        outcomes, not replica symptoms — counting them would let one
+        impatient client kill a healthy replica."""
+        fn = getattr(self.engine, "fault_stats", None)
+        if fn is None:
+            return 0
+        fs = fn()
+        return int(fs.get("nonfinite_quarantined", 0)) + int(
+            fs.get("prefill_errors", 0)
+        )
+
+
+def _is_queued(engine: Any, local_rid: int) -> bool:
+    """Queued-but-unstarted test, duck-typed: real engines expose
+    ``scheduler.has``; the unit tests' fakes expose ``has_queued``."""
+    sched = getattr(engine, "scheduler", None)
+    if sched is not None and hasattr(sched, "has"):
+        return bool(sched.has(local_rid))
+    return bool(engine.has_queued(local_rid))
+
+
+class FleetRouter:
+    """The fleet front door. Pure host, jax-free; engines are duck-typed
+    against the ``ServeEngine`` surface (``submit`` / ``step`` /
+    ``cancel`` / ``idle`` / counters / ``fault_stats`` / ``stats``).
+
+    Parameters
+    ----------
+    engines: the N replicas. Replica index = position in this list.
+    affinity_depth: prompt-prefix tokens feeding :func:`affinity_hash`.
+    hedge_after_s: duplicate a request stuck on a SUSPECT replica after
+        this many seconds (None = hedging off, the default).
+    suspect_after_s / dead_after_s: heartbeat ages (no observable
+        progress while non-idle) that demote healthy -> suspect ->
+        dead.
+    fault_streak: consecutive faulty observations before a replica goes
+        suspect (twice that: dead).
+    queue_full_streak: consecutive ``QueueFull`` bounces before the
+        replica is marked suspect (overload, not death — it recovers on
+        its next observed progress).
+    probe_after_s: circuit-breaker half-open delay — how long a dead
+        replica rests before the next submission probes it.
+    chaos: a :class:`..utils.chaos.FleetChaosConfig` for deterministic
+        replica-level fault injection (kill at a chain count, stall for
+        N scheduling rounds).
+    flight: the ROUTER's own :class:`..obs.flight.FlightRecorder` for
+        ``replica_health`` / ``redispatch`` / ``hedge`` / ``stall``
+        events; replica engines carry their own recorders.
+    clock: injectable monotonic clock (tests pin health/probe timing
+        with a fake; defaults to ``time.perf_counter``).
+    """
+
+    def __init__(self, engines: List[Any], *,
+                 affinity_depth: int = 16,
+                 hedge_after_s: Optional[float] = None,
+                 suspect_after_s: float = 1.0,
+                 dead_after_s: float = 5.0,
+                 fault_streak: int = 3,
+                 queue_full_streak: int = 3,
+                 probe_after_s: float = 1.0,
+                 chaos: Any = None,
+                 flight: Any = None,
+                 clock: Optional[Callable[[], float]] = None):
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        self._affinity_depth = int(affinity_depth)
+        self._hedge_after_s = hedge_after_s
+        self._suspect_after_s = float(suspect_after_s)
+        self._dead_after_s = float(dead_after_s)
+        self._fault_streak_limit = int(fault_streak)
+        self._queue_full_limit = int(queue_full_streak)
+        self._probe_after_s = float(probe_after_s)
+        self._chaos = chaos
+        self._flight = flight
+        self._clock = clock if clock is not None else time.perf_counter
+        self.ledger = DispatchLedger()
+        self._next_gid = 0
+        self._requests: Dict[int, Request] = {}
+        # (replica, local_rid) cancellations the ROUTER issued (hedge
+        # losers, drain moves): their "cancelled" completions are
+        # absorbed, never delivered.
+        self._router_cancelled: set = set()
+        self._closed = False
+        self.n_spillovers = 0
+        self.n_probes = 0
+        self.n_dead_completions = 0
+        self.n_health_transitions = 0
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    def replica_states(self) -> List[str]:
+        return [r.state for r in self._replicas]
+
+    @property
+    def idle(self) -> bool:
+        """Nothing left that can change caller-visible state: every
+        accepted request has its one delivered completion and no live
+        replica still works on an UNdelivered one. A cancelled hedge
+        loser grinding on a stalled replica does not hold the fleet
+        non-idle — its eventual completion is absorbed, not delivered
+        (dead replicas are resolved by the step loop, so their entries
+        close without the engine going idle)."""
+        if self.ledger.open_ids():
+            return False
+        return all(
+            rep.state == DEAD or rep.engine.idle or all(
+                self.ledger.entries[g].delivered is not None
+                for g in rep.local_gid.values()
+            )
+            for rep in self._replicas
+        )
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, request: Request) -> int:
+        """Place one request on the fleet; returns its GLOBAL id.
+        Routing is prefix-affine with failover (see module docstring);
+        the request object passed in is never mutated — a pristine
+        template is kept for re-dispatch/hedging and a fresh clone goes
+        to each engine (engines stamp ``request_id``/``submitted_s`` on
+        what they are given). Raises ``QueueFull`` / ``QueueClosed`` /
+        ``ValueError`` only when NO replica admits — the engine's
+        synchronous-admission contract, fleet-wide."""
+        if self._closed:
+            raise QueueClosed("fleet router is closed")
+        template = dataclasses.replace(request)
+        now = self._clock()
+        probe = self._probe_candidate(now)
+        order = ([probe] if probe is not None else []) + self._route_order(
+            template
+        )
+        last_exc: Optional[Exception] = None
+        for rep in order:
+            try:
+                local = rep.engine.submit(dataclasses.replace(template))
+            except QueueFull as e:
+                rep.queue_full_streak += 1
+                self.n_spillovers += 1
+                if (rep.queue_full_streak >= self._queue_full_limit
+                        and rep.state == HEALTHY):
+                    self._transition(rep, SUSPECT, "queue_full_streak", now)
+                last_exc = e
+                continue
+            except (QueueClosed, ValueError) as e:
+                last_exc = e
+                continue
+            rep.queue_full_streak = 0
+            gid = self._next_gid
+            self._next_gid += 1
+            self._requests[gid] = template
+            rep.local_gid[local] = gid
+            self.ledger.accepted(gid)
+            kind = "probe" if rep is probe else "dispatch"
+            self.ledger.dispatched(gid, rep.index, local, kind, now)
+            if rep is probe:
+                rep.probing = True
+                rep.probe_gid = gid
+                self.n_probes += 1
+                self._record("replica_health", replica=rep.index,
+                             frm=DEAD, to="probing", reason="half_open")
+            return gid
+        if last_exc is not None:
+            raise last_exc
+        raise QueueFull("no routable replica")
+
+    def _route_order(self, request: Request) -> List[_Replica]:
+        """The affinity ring from the request's hash: healthy replicas
+        in ring order, then suspect ones (still serving, just avoided).
+        Dead and draining replicas take no new traffic."""
+        h = affinity_hash(
+            request.prompt, adapter=int(getattr(request, "adapter", 0)),
+            depth=self._affinity_depth,
+        )
+        n = len(self._replicas)
+        ring = [self._replicas[(h + k) % n] for k in range(n)]
+        return (
+            [r for r in ring if r.state == HEALTHY]
+            + [r for r in ring if r.state == SUSPECT]
+        )
+
+    def _probe_candidate(self, now: float) -> Optional[_Replica]:
+        """First dead replica whose circuit-breaker rest expired and has
+        no probe outstanding — the half-open state. The next submission
+        becomes its probe; exactly-once machinery makes the gamble safe
+        (a failed probe's request is re-dispatched like any other)."""
+        for rep in self._replicas:
+            if (rep.state == DEAD and not rep.probing
+                    and rep.dead_since is not None
+                    and now - rep.dead_since >= self._probe_after_s):
+                return rep
+        return None
+
+    # -- the scheduling round ---------------------------------------------
+
+    def step(self) -> List[Completion]:
+        """One fleet round: step every live replica, observe symptoms,
+        apply health transitions, resolve dead replicas' outstanding
+        work (re-dispatch queued, synthesize ``replica_dead`` for
+        in-flight), then hedge stragglers. Returns completions with
+        GLOBAL ids, exactly one per accepted request ever."""
+        out: List[Completion] = []
+        for rep in self._replicas:
+            now = self._clock()
+            if self._chaos_killed(rep):
+                # a chaos kill is PERMANENT: never step the engine (it
+                # is actually fine — death is simulated at the router
+                # boundary), and a half-open probe against it fails,
+                # re-opening the circuit with a fresh timer.
+                if rep.state != DEAD:
+                    self._mark_dead(rep, "chaos_kill", now)
+                elif rep.probing:
+                    rep.probing = False
+                    rep.probe_gid = None
+                    rep.dead_since = now
+                    self._record("replica_health", replica=rep.index,
+                                 frm="probing", to=DEAD,
+                                 reason="probe_failed:chaos_kill")
+                continue
+            if rep.state == DEAD and not rep.probing:
+                continue
+            if self._chaos_stalled(rep):
+                rep.stall_skips += 1
+                self._record("stall", replica=rep.index,
+                             skipped_round=rep.stall_skips)
+                self._observe(rep, now, stalled=True)
+                continue
+            try:
+                comps = rep.engine.step()
+            except Exception as e:  # engine blew up: circuit opens
+                self._mark_dead(
+                    rep, f"step_raised:{type(e).__name__}", now
+                )
+                continue
+            out.extend(self._collect(rep, comps, self._clock()))
+            self._observe(rep, self._clock())
+        now = self._clock()
+        out.extend(self._resolve_dead(now))
+        self._maybe_hedge(now)
+        return out
+
+    def run_until_idle(self, max_steps: int = 10_000) -> List[Completion]:
+        out: List[Completion] = []
+        for _ in range(max_steps):
+            if self.idle and self._engines_drained():
+                return out
+            out.extend(self.step())
+        raise RuntimeError(f"fleet not idle after {max_steps} steps")
+
+    def _engines_drained(self) -> bool:
+        """Caller-visible idleness is not the whole story: a pipelined
+        engine can hold a dispatched-but-uncollected trailing bubble
+        chain (counted in ``n_chains`` at dispatch) after its last
+        delivery. Keep stepping until every HEALTHY replica's engine is
+        itself idle, so the fleet fetch budget stays exactly the SUM of
+        per-replica budgets and no launch is left in flight. Only
+        healthy replicas are waited on: a suspect/dead/frozen replica
+        may never drain (the hedged-straggler case — its leftover work
+        is a cancelled loser whose eventual completion is absorbed),
+        and blocking on it would hang the loop; chaos-killed/-stalled
+        replicas are skipped by the step loop entirely."""
+        return all(
+            rep.state != HEALTHY
+            or self._chaos_killed(rep)
+            or self._chaos_stalled(rep)
+            or bool(getattr(rep.engine, "idle", True))
+            for rep in self._replicas
+        )
+
+    def cancel(self, gid: int) -> bool:
+        """Caller-side cancellation by GLOBAL id: forwarded to every
+        live dispatch (the first resulting "cancelled" completion is
+        delivered, any other is deduplicated by the ledger)."""
+        entry = self.ledger.entries.get(gid)
+        if entry is None or entry.delivered is not None:
+            return False
+        any_known = False
+        for rep_i, local, _, _ in entry.dispatches:
+            rep = self._replicas[rep_i]
+            if local in rep.local_gid:
+                try:
+                    any_known = bool(rep.engine.cancel(local)) or any_known
+                except Exception:
+                    pass
+        return any_known
+
+    def close(self) -> None:
+        """Fleet-wide admission stop (synchronous ``QueueClosed``
+        backpressure on later submits); accepted work is unaffected."""
+        self._closed = True
+        for rep in self._replicas:
+            if rep.state != DEAD:
+                try:
+                    rep.engine.close()
+                except Exception:
+                    pass
+
+    def drain(self, max_steps: int = 10_000) -> List[Completion]:
+        """Graceful fleet shutdown: close, then run every accepted
+        request to its one completion."""
+        self.close()
+        return self.run_until_idle(max_steps)
+
+    # -- rolling drain -----------------------------------------------------
+
+    def drain_replica(self, index: int) -> int:
+        """Put one replica into rolling drain: no new traffic, its
+        QUEUED requests move to healthy replicas in submit order (the
+        local cancellation's completion is absorbed — the move is
+        invisible to callers), in-flight requests finish normally.
+        Returns how many requests moved. Pair with
+        :meth:`undrain_replica` for a rolling restart."""
+        rep = self._replicas[index]
+        if rep.state == DEAD:
+            raise ValueError(f"replica {index} is dead, not drainable")
+        if rep.state != DRAINING:
+            self._transition(rep, DRAINING, "drain_replica", self._clock())
+        moved = 0
+        # dict preserves insertion order == local submit order
+        for local, gid in list(rep.local_gid.items()):
+            if not _is_queued(rep.engine, local):
+                continue
+            target = self._place(
+                self._requests[gid], gid, kind="redispatch",
+                exclude={rep.index},
+            )
+            if target is None:
+                continue  # fleet saturated: it finishes on the drainer
+            rep.engine.cancel(local)
+            self._router_cancelled.add((rep.index, local))
+            self._record("redispatch", gid=gid, frm=rep.index,
+                         to=target.index, reason="drain")
+            moved += 1
+        return moved
+
+    def undrain_replica(self, index: int) -> None:
+        """Return a drained replica to service (rolling restart done)."""
+        rep = self._replicas[index]
+        if rep.state != DRAINING:
+            raise ValueError(
+                f"replica {index} is {rep.state!r}, not draining"
+            )
+        rep.fault_streak = 0
+        rep.queue_full_streak = 0
+        rep.heartbeat = None
+        rep.last_sig = None
+        self._transition(rep, HEALTHY, "undrain_replica", self._clock())
+
+    # -- completion collection --------------------------------------------
+
+    def _collect(self, rep: _Replica, comps: List[Completion],
+                 now: float) -> List[Completion]:
+        delivered: List[Completion] = []
+        for c in comps:
+            gid = rep.local_gid.pop(c.request_id, None)
+            if gid is None:
+                continue  # not router-placed (or already resolved)
+            if (rep.index, c.request_id) in self._router_cancelled:
+                self._router_cancelled.discard((rep.index, c.request_id))
+                self.ledger.absorbed(
+                    gid, rep.index, c.request_id, c.finish_reason
+                )
+                continue
+            entry = self.ledger.entries[gid]
+            if entry.delivered is not None:
+                # hedge race: the other replica already won
+                self.ledger.absorbed(
+                    gid, rep.index, c.request_id, c.finish_reason
+                )
+                continue
+            # first completion wins; cancel any other live dispatch
+            for rep_i, local, _, _ in entry.dispatches:
+                if rep_i == rep.index and local == c.request_id:
+                    continue
+                loser = self._replicas[rep_i]
+                if local in loser.local_gid:
+                    try:
+                        loser.engine.cancel(local)
+                    except Exception:
+                        pass
+                    self._router_cancelled.add((rep_i, local))
+            self.ledger.delivered(gid, rep.index, c.finish_reason)
+            if rep.probing and gid == rep.probe_gid:
+                self._resolve_probe(rep, c.finish_reason, now)
+            if c.request_id == gid:
+                delivered.append(c)  # N=1 parity: identical object
+            else:
+                delivered.append(dataclasses.replace(c, request_id=gid))
+        return delivered
+
+    def _resolve_probe(self, rep: _Replica, reason: str,
+                       now: float) -> None:
+        rep.probing = False
+        rep.probe_gid = None
+        if reason in ("length", "eos"):
+            rep.fault_streak = 0
+            rep.queue_full_streak = 0
+            rep.heartbeat = now
+            rep.last_faults = rep.fault_total()
+            self._transition(rep, HEALTHY, "probe_ok", now)
+        else:
+            rep.dead_since = now  # circuit re-opens, timer restarts
+            self._record("replica_health", replica=rep.index,
+                         frm="probing", to=DEAD,
+                         reason=f"probe_failed:{reason}")
+
+    # -- health observation ------------------------------------------------
+
+    def _observe(self, rep: _Replica, now: float,
+                 stalled: bool = False) -> None:
+        sig = rep.progress_signature()
+        idle = bool(getattr(rep.engine, "idle", False))
+        progressed = (not stalled) and (
+            idle or rep.last_sig is None or sig != rep.last_sig
+        )
+        rep.last_sig = sig
+        faults = rep.fault_total()
+        if faults > rep.last_faults:
+            rep.fault_streak += 1
+        elif progressed:
+            rep.fault_streak = 0
+        rep.last_faults = faults
+        if rep.heartbeat is None:
+            rep.heartbeat = now
+        if progressed:
+            rep.heartbeat = now
+            if rep.state == SUSPECT and rep.fault_streak == 0:
+                self._transition(rep, HEALTHY, "progress", now)
+        if rep.state not in (HEALTHY, SUSPECT):
+            return
+        if rep.fault_streak >= 2 * self._fault_streak_limit:
+            self._mark_dead(rep, "fault_streak", now)
+            return
+        if (rep.fault_streak >= self._fault_streak_limit
+                and rep.state == HEALTHY):
+            self._transition(rep, SUSPECT, "fault_streak", now)
+        age = now - rep.heartbeat
+        if age > self._dead_after_s:
+            self._mark_dead(rep, "heartbeat", now)
+        elif age > self._suspect_after_s and rep.state == HEALTHY:
+            self._transition(rep, SUSPECT, "heartbeat", now)
+
+    def _transition(self, rep: _Replica, to: str, reason: str,
+                    now: float) -> None:
+        frm = rep.state
+        if frm == to:
+            return
+        rep.state = to
+        self.n_health_transitions += 1
+        self._record("replica_health", replica=rep.index, frm=frm,
+                     to=to, reason=reason)
+
+    def _mark_dead(self, rep: _Replica, reason: str, now: float) -> None:
+        rep.dead_since = now
+        rep.dead_reason = reason
+        rep.probing = False
+        rep.probe_gid = None
+        self._transition(rep, DEAD, reason, now)
+
+    # -- dead-replica resolution ------------------------------------------
+
+    def _resolve_dead(self, now: float) -> List[Completion]:
+        """Exactly-once re-dispatch: move a dead replica's queued
+        requests to live replicas (same template, same seed — token
+        streams identical) and synthesize ``replica_dead`` completions
+        for the in-flight ones. Every local id is also cancelled on the
+        dead engine, so a later probe revival cannot replay work the
+        router already resolved."""
+        out: List[Completion] = []
+        for rep in self._replicas:
+            # a probing replica is half-open, not dead-dead: its probe
+            # request must be left to complete (or fail) on it —
+            # resolving it here would cancel the probe every round and
+            # the circuit could never close.
+            if rep.state != DEAD or rep.probing or not rep.local_gid:
+                continue
+            for local, gid in list(rep.local_gid.items()):
+                try:
+                    queued = _is_queued(rep.engine, local)
+                except Exception:
+                    queued = False
+                try:
+                    rep.engine.cancel(local)
+                except Exception:
+                    pass
+                del rep.local_gid[local]
+                self._router_cancelled.add((rep.index, local))
+                entry = self.ledger.entries[gid]
+                if entry.delivered is not None:
+                    continue  # hedge twin already completed it
+                if queued and self._live_dispatches(entry):
+                    continue  # hedge twin still running elsewhere
+                target = None
+                if queued:
+                    target = self._place(
+                        self._requests[gid], gid, kind="redispatch",
+                        exclude={rep.index},
+                    )
+                if target is not None:
+                    self._record("redispatch", gid=gid, frm=rep.index,
+                                 to=target.index, reason="replica_dead")
+                    continue
+                if self._live_dispatches(entry):
+                    continue  # a hedge twin will deliver
+                template = self._requests[gid]
+                self.ledger.delivered(gid, rep.index, REPLICA_DEAD)
+                self.n_dead_completions += 1
+                out.append(Completion(
+                    request_id=gid, prompt=template.prompt, tokens=[],
+                    finish_reason=REPLICA_DEAD, latency_s=0.0,
+                ))
+        return out
+
+    def _live_dispatches(
+        self, entry: LedgerEntry
+    ) -> List[Tuple[int, int]]:
+        return [
+            (r, l) for r, l, _, _ in entry.dispatches
+            if l in self._replicas[r].local_gid
+            and self._replicas[r].local_gid[l] == entry.gid
+        ]
+
+    def _place(self, template: Request, gid: int, kind: str,
+               exclude: set) -> Optional[_Replica]:
+        """Re-dispatch/hedge placement: the affinity ring minus
+        ``exclude``. Hedges go to HEALTHY replicas only (a hedge onto a
+        suspect replica would just mint a second straggler);
+        re-dispatches fall back to suspect replicas — a slow completion
+        beats a synthesized loss. Returns the chosen replica, or None
+        when the fleet has nowhere to put it."""
+        now = self._clock()
+        allow_suspect = kind == "redispatch"
+        for rep in self._route_order(template):
+            if rep.index in exclude:
+                continue
+            if rep.state != HEALTHY and not allow_suspect:
+                continue
+            try:
+                local = rep.engine.submit(dataclasses.replace(template))
+            except (QueueFull, QueueClosed, ValueError):
+                continue
+            rep.local_gid[local] = gid
+            self.ledger.dispatched(gid, rep.index, local, kind, now)
+            return rep
+        return None
+
+    # -- hedging -----------------------------------------------------------
+
+    def _maybe_hedge(self, now: float) -> None:
+        if self._hedge_after_s is None:
+            return
+        for gid in self.ledger.open_ids():
+            entry = self.ledger.entries[gid]
+            live = self._live_dispatches(entry)
+            if len(live) != 1:
+                continue  # already hedged (or being resolved)
+            rep_i, _local = live[0]
+            rep = self._replicas[rep_i]
+            if rep.state != SUSPECT:
+                continue
+            age = now - entry.dispatches[-1][3]
+            if age < self._hedge_after_s:
+                continue
+            target = self._place(
+                self._requests[gid], gid, kind="hedge",
+                exclude={rep_i},
+            )
+            if target is not None:
+                self._record("hedge", gid=gid, frm=rep_i,
+                             to=target.index)
+
+    # -- chaos -------------------------------------------------------------
+
+    def _chaos_killed(self, rep: _Replica) -> bool:
+        if self._chaos is None or not getattr(self._chaos, "kills", False):
+            return False
+        from ..utils.chaos import replica_killed
+
+        return replica_killed(
+            self._chaos, rep.index, rep.progress_signature()[0]
+        )
+
+    def _chaos_stalled(self, rep: _Replica) -> bool:
+        if self._chaos is None or not getattr(self._chaos, "stalls", False):
+            return False
+        from ..utils.chaos import replica_stall_pending
+
+        return replica_stall_pending(
+            self._chaos, rep.index, rep.progress_signature()[0],
+            rep.stall_skips,
+        )
+
+    # -- observability / receipts -----------------------------------------
+
+    def _record(self, kind: str, **fields: Any) -> None:
+        if self._flight is not None:
+            self._flight.record(kind, **fields)
+
+    def router_stats(self) -> Dict[str, Any]:
+        """The fleet part of the receipt. Config fields (``n_replicas``,
+        ``hedge``, ``affinity``) are fingerprinted by regress.py so
+        fleet and single-engine rounds never gate each other; the
+        health/ledger counters are OUTCOMES and deliberately stay out of
+        the fingerprint, mirroring the chaos precedent."""
+        states = self.replica_states()
+        return {
+            "n_replicas": self.n_replicas,
+            "hedge": float(self._hedge_after_s or 0.0),
+            "affinity": self._affinity_depth,
+            "replicas_dead": states.count(DEAD),
+            "replicas_draining": states.count(DRAINING),
+            "requests_accepted": len(self.ledger.entries),
+            "redispatched": self.ledger.n_redispatched,
+            "hedged": self.ledger.n_hedged,
+            "absorbed": self.ledger.n_absorbed,
+            "replica_dead_completions": self.n_dead_completions,
+            "queue_spillovers": self.n_spillovers,
+            "probes": self.n_probes,
+            "health_transitions": self.n_health_transitions,
+        }
+
+    # Engine-stats keys that describe CONFIGURATION (identical across a
+    # homogeneous fleet): the merge passes the first replica's value
+    # through. Everything else numeric is a traffic counter and SUMS —
+    # equality across replicas must not suppress the sum (two replicas
+    # that each served 4 requests served 8).
+    _CONFIG_STAT_KEYS = frozenset({
+        "prefix_cache", "speculative", "spec_k", "spec_ngram",
+        "adapters", "n_adapters", "lora_rank", "deadline_s",
+        "guard_nonfinite", "chaos", "flight", "pipeline_depth",
+        "prefill_chunk",
+    })
+    # Derived ratios: recomputed or dropped rather than summed.
+    _RATIO_STAT_KEYS = frozenset({
+        "prefix_hit_rate", "spec_mean_accepted_len",
+        "spec_acceptance_rate",
+    })
+
+    def stats(self, *parts: str) -> Dict[str, Any]:
+        """One merged fleet receipt over ``router_stats`` + every
+        replica's ``stats(parts)``: config keys pass through, traffic
+        counters SUM, derived ratios are dropped (a mean of means
+        lies), and flight keys are recomputed from the bucket-wise
+        MERGED histograms via :meth:`fleet_flight_summary` (summing a
+        p95 across replicas would be meaningless)."""
+        out = self.router_stats()
+        per: List[dict] = []
+        for rep in self._replicas:
+            fn = getattr(rep.engine, "stats", None)
+            if fn is not None:
+                per.append(dict(fn(*parts)))
+        flight = self.fleet_flight_summary()
+        merged: Dict[str, Any] = {}
+        for d in per:
+            for k, v in d.items():
+                if k in self._RATIO_STAT_KEYS:
+                    continue
+                if flight is not None and k.startswith((
+                    "flight", "ttft_", "e2e_", "queue_wait_",
+                    "chain_util_", "chain_overlap_",
+                )):
+                    continue  # superseded by the histogram merge
+                if k not in merged:
+                    merged[k] = v
+                elif k not in self._CONFIG_STAT_KEYS and isinstance(
+                    v, (int, float)
+                ) and isinstance(merged[k], (int, float)):
+                    merged[k] = merged[k] + v
+        out.update(merged)
+        if flight is not None:
+            out.update(flight)
+        return out
+
+    def _tagged_snapshots(self) -> List[Tuple[Any, dict]]:
+        tagged: List[Tuple[Any, dict]] = []
+        if self._flight is not None:
+            tagged.append(("router", self._flight.snapshot()))
+        for rep in self._replicas:
+            rec = getattr(rep.engine, "_flight", None)
+            if rec is None:
+                rec = getattr(rep.engine, "flight", None)
+            if rec is not None and hasattr(rec, "snapshot"):
+                tagged.append((rep.index, rec.snapshot()))
+        return tagged
+
+    def fleet_flight_summary(self) -> Optional[Dict[str, Any]]:
+        """Receipt-grade flight aggregate across the fleet, or None when
+        no recorder is attached anywhere. Percentiles come from the
+        MERGED histograms — mergeability is why LogHistogram exists."""
+        from ..obs.flight import summarize_merged
+
+        tagged = self._tagged_snapshots()
+        if not tagged:
+            return None
+        return summarize_merged([snap for _, snap in tagged])
+
+    def fleet_snapshot(self, reason: str = "fleet") -> Optional[dict]:
+        """One merged ``graft-flightlog/v1`` snapshot over the router's
+        and every replica's recorder: events tagged ``replica=i`` (the
+        router's as ``replica="router"``), interleaved by timestamp —
+        pass the same ``t0`` to every recorder or the interleaving is
+        per-recorder-relative. ``scripts/flight_view.py`` renders it."""
+        from ..obs.flight import merge_snapshots
+
+        tagged = self._tagged_snapshots()
+        if not tagged:
+            return None
+        return merge_snapshots(tagged, reason=reason)
+
+    def dump_fleet(self, path: str, reason: str = "fleet") -> Optional[dict]:
+        """Append the merged fleet snapshot to ``path`` (JSONL)."""
+        import json
+
+        snap = self.fleet_snapshot(reason=reason)
+        if snap is not None:
+            with open(path, "a") as f:
+                f.write(json.dumps(snap) + "\n")
+        return snap

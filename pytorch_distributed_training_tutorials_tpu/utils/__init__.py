@@ -1,9 +1,36 @@
-"""Utilities: rank-0 logging, metrics formatting, pytree helpers."""
+"""Utilities: rank-0 logging, metrics formatting, pytree helpers, chaos.
 
-from pytorch_distributed_training_tutorials_tpu.utils.logging import (  # noqa: F401
-    log0,
-    epoch_line,
-)
-from pytorch_distributed_training_tutorials_tpu.utils.tree import (  # noqa: F401
-    device_materialize,
-)
+The re-exports below are PEP 562 LAZY (same pattern as obs/ and serve/):
+:mod:`.tree` imports jax, but :mod:`.chaos` is host-only by contract —
+the fleet router's replica-level injectors must be importable on a
+jax-less laptop (the subprocess pin in tests/test_prefix.py imports
+``pytorch_distributed_training_tutorials_tpu.utils.chaos`` and asserts jax never loads), so the
+package init must not eagerly drag :mod:`.tree` in.
+"""
+
+import importlib
+
+# name -> submodule; resolved on first access via __getattr__.
+_LAZY_EXPORTS = {
+    "log0": "pytorch_distributed_training_tutorials_tpu.utils.logging",
+    "epoch_line": "pytorch_distributed_training_tutorials_tpu.utils.logging",
+    "device_materialize": "pytorch_distributed_training_tutorials_tpu.utils.tree",
+}
+
+__all__ = sorted(_LAZY_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        module_name = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value  # cache: __getattr__ runs once per name
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))

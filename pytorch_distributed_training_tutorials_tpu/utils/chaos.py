@@ -25,10 +25,17 @@ Two injector families:
   so a post-rollback replay does not re-trigger the same spike — the
   restore-and-continue semantics rollback implements).
 
+A third family arrived with the fleet router (ISSUE 12):
+**replica-level** injectors (:class:`FleetChaosConfig`,
+:func:`replica_killed`, :func:`replica_stall_pending`) simulate a whole
+replica dying at a fixed chain count or freezing for N scheduling
+rounds — consumed by :class:`..serve.router.FleetRouter`, which is
+jax-free, so these are plain host predicates.
+
 The module is jax-free at import (``jax.numpy`` is imported inside the
 device-side injectors only when they run): host-only consumers — the
-scheduler tests, the selftest argument parser — can use configs without
-touching XLA, per the import-purity hard rule.
+scheduler tests, the selftest argument parser, the fleet router — can
+use configs without touching XLA, per the import-purity hard rule.
 """
 
 from __future__ import annotations
@@ -112,6 +119,76 @@ class ChaosConfig:
     @property
     def stalls(self) -> bool:
         return self.stall_chain >= 0 and self.stall_s > 0
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetChaosConfig:
+    """Replica-level fault injection for the fleet router (ISSUE 12).
+    Same philosophy as :class:`ChaosConfig`: ``-1`` disables an
+    injector, every firing is keyed to deterministic host counters
+    (replica index, the replica's chain count, the router's own round
+    counter) so a chaos fleet run is reproducible bit for bit.
+
+    - ``kill_replica`` / ``kill_at_chain``: the router declares that
+      replica dead once its chain counter reaches ``kill_at_chain`` —
+      PERMANENTLY (a half-open probe against a chaos-killed replica
+      fails, exercising the circuit re-open path). The engine process
+      is untouched; death is simulated at the router boundary, which is
+      exactly where a real death is observed.
+    - ``stall_replica`` / ``stall_from_chain`` / ``stall_rounds``: once
+      the replica's chain counter reaches ``stall_from_chain``, the
+      router skips stepping it for ``stall_rounds`` scheduling rounds —
+      a progress freeze (heartbeat ages, suspicion and hedging fire)
+      with no wall-clock sleep, so chaos tests stay fast and flake-free.
+    - ``seed`` rides into receipts/fingerprints; the injectors are
+      deterministic.
+
+    The poison-a-replica path needs no new injector: hand ONE replica's
+    engine an engine-level :class:`ChaosConfig` with
+    ``nan_logit_slot``/``nan_logit_step`` and the router observes the
+    resulting fault-stat deltas.
+    """
+
+    kill_replica: int = -1
+    kill_at_chain: int = -1
+    stall_replica: int = -1
+    stall_from_chain: int = 0
+    stall_rounds: int = 0
+    seed: int = 0
+
+    @property
+    def kills(self) -> bool:
+        return self.kill_replica >= 0 and self.kill_at_chain >= 0
+
+    @property
+    def stalls(self) -> bool:
+        return self.stall_replica >= 0 and self.stall_rounds > 0
+
+
+def replica_killed(cfg: FleetChaosConfig, replica: int,
+                   n_chains: int) -> bool:
+    """True once the configured victim replica has dispatched
+    ``kill_at_chain`` chains — and forever after (monotonic counter, so
+    a killed replica stays killed across probe attempts)."""
+    return (
+        cfg.kills
+        and replica == cfg.kill_replica
+        and n_chains >= cfg.kill_at_chain
+    )
+
+
+def replica_stall_pending(cfg: FleetChaosConfig, replica: int,
+                          n_chains: int, rounds_consumed: int) -> bool:
+    """True while the configured replica should stay frozen: its chain
+    counter passed ``stall_from_chain`` and fewer than ``stall_rounds``
+    scheduling rounds have been skipped so far (the router counts the
+    skips it performs and passes them back as ``rounds_consumed``)."""
+    return (
+        cfg.stalls
+        and replica == cfg.stall_replica
+        and n_chains >= cfg.stall_from_chain
+        and rounds_consumed < cfg.stall_rounds
+    )
 
 
 # ---------------------------------------------------------------- device side

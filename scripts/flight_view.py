@@ -59,11 +59,15 @@ def _chain_annotations(events: list[dict]) -> dict[int, str]:
         c = ev.get("chain")
         if c is None:
             continue
+        # fleet dumps (merge_snapshots) interleave chains from N
+        # replicas whose counters collide — scope by the replica tag so
+        # replica 1's chain 3 never reads as in flight over replica 0's
+        rep = ev.get("replica")
         if ev.get("kind") == "chain_start":
-            open_chains.add(c)
+            open_chains.add((rep, c))
         elif ev.get("kind") == "chain_end":
-            open_chains.discard(c)
-            later = sorted(x for x in open_chains if x > c)
+            open_chains.discard((rep, c))
+            later = sorted(x for r, x in open_chains if r == rep and x > c)
             if later:
                 notes[id(ev)] = " [in flight: chain " + ", ".join(
                     str(x) for x in later
@@ -71,9 +75,29 @@ def _chain_annotations(events: list[dict]) -> dict[int, str]:
     return notes
 
 
+def _health_annotations(events: list[dict]) -> dict[int, str]:
+    """A replica dying (or entering its drain) is the most load-bearing
+    line on a fleet timeline: flag the router's terminal
+    ``replica_health`` transitions inline so they stand out of the
+    interleaved per-replica traffic without a separate fleet mode."""
+    notes: dict[int, str] = {}
+    for ev in events:
+        if ev.get("kind") != "replica_health":
+            continue
+        to = ev.get("to")
+        if to in ("dead", "draining"):
+            notes[id(ev)] = f" [{to}]"
+    return notes
+
+
 def _fmt_span(span: dict) -> str:
     rid = span.get("rid", "?")
-    parts = [f"  request {rid}:"]
+    # fleet dumps tag every span with its replica; local rids collide
+    # across replicas, so the tag is what disambiguates "request 3"
+    if "replica" in span:
+        parts = [f"  replica {span['replica']} request {rid}:"]
+    else:
+        parts = [f"  request {rid}:"]
     submit = span.get("submit_t")
     if submit is not None:
         if "queue_pop_t" in span:
@@ -111,6 +135,7 @@ def render(snap: dict, index: int, max_events: int) -> None:
         print(f"event counts: {line}")
     trigger = snap.get("trigger")
     notes = _chain_annotations(snap["events"])
+    notes.update(_health_annotations(snap["events"]))
     print(f"\nevents (last {min(max_events, len(snap['events']))}):")
     for ev in snap["events"][-max_events:]:
         print(_fmt_event(ev, trigger, notes.get(id(ev), "")))

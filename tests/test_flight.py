@@ -389,3 +389,53 @@ def test_flight_view_annotates_overlapped_chains(tmp_path):
     last_end = [ln for ln in out.stdout.splitlines()
                 if "chain_end" in ln and "chain=1" in ln]
     assert last_end and all("in flight" not in ln for ln in last_end)
+
+
+def test_flight_view_renders_fleet_dump(tmp_path):
+    """A merged fleet dump (FleetRouter.dump_fleet's format) renders
+    with ``replica=`` tags on events, replica-tagged request spans, the
+    router's terminal health transitions flagged inline, and chain
+    in-flight annotations scoped PER replica — two replicas' colliding
+    chain counters must never cross-annotate."""
+    import json as _json
+    import subprocess
+    from pathlib import Path
+
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import merge_snapshots
+
+    repo = Path(__file__).resolve().parents[1]
+    t0 = 0.0
+    recs = [FlightRecorder(capacity=32, t0=t0) for _ in range(2)]
+    router_rec = FlightRecorder(capacity=32, t0=t0)
+    # replica 0: chain 0 opens and closes with replica 1's own chain 0
+    # still open — same counter value, different replica, so replica
+    # 0's chain_end must NOT claim replica 1's chain is "in flight"
+    recs[1].chain_start(1, 2, chain=0)
+    recs[0].chain_start(1, 2, chain=0)
+    recs[0].chain_end(tokens=4, occupancy=1, chain=0)
+    recs[0].request_submitted(3, p_len=4, max_new=2)
+    recs[0].request_completed(3, "length", tokens=2, latency_s=0.25,
+                              ttft_s=0.1)
+    router_rec.record("replica_health", replica=1, frm="suspect",
+                      to="dead", reason="heartbeat")
+    # a router event with no replica field gets the router's own tag
+    router_rec.record("redispatch", gid=3, frm=1, to=0)
+    recs[1].chain_end(tokens=4, occupancy=1, chain=0)
+    snap = merge_snapshots(
+        [(0, recs[0].snapshot()), (1, recs[1].snapshot()),
+         ("router", router_rec.snapshot())],
+        reason="end_of_stream",
+    )
+    path = str(tmp_path / "fleet.jsonl")
+    with open(path, "w") as f:
+        f.write(_json.dumps(snap) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "flight_view.py"), path],
+        capture_output=True, text=True, timeout=120, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "[dead]" in out.stdout  # the health annotation
+    assert "replica 0 request 3:" in out.stdout  # tagged span
+    assert "replica=router" in out.stdout  # the router's own events
+    # per-replica chain scoping: nothing reads as overlapped here
+    assert "in flight" not in out.stdout

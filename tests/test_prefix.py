@@ -201,6 +201,10 @@ def test_prefix_module_imports_no_jax():
         # tooling that must run on jax-less laptops over scp'd dumps
         "import pytorch_distributed_training_tutorials_tpu.obs.flight\n"
         "import pytorch_distributed_training_tutorials_tpu.obs.histogram\n"
+        # the fleet router + its chaos injectors (ISSUE 12) are pure
+        # host routing decisions — same contract as the scheduler
+        "import pytorch_distributed_training_tutorials_tpu.serve.router\n"
+        "import pytorch_distributed_training_tutorials_tpu.utils.chaos\n"
         "assert 'jax' not in sys.modules, 'prefix index must not import jax'\n"
     )
     env = {k: v for k, v in os.environ.items() if k != "PYTHONSTARTUP"}

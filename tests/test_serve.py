@@ -70,9 +70,18 @@ The load-bearing pins:
   tokens, a co-scheduled short request is never starved behind a long
   chunked prefill, and depth-1/chunk-0 engines keep byte-identical
   state trees and compiled-program counts;
+- fleet resilience (ISSUE 12) is INVISIBLE in the tokens: an N=1
+  ``FleetRouter`` is a transparent wrapper (byte-identical completions,
+  slot-state trees, and compiled-program counts vs driving the engine
+  directly), a real-engine fleet composed with prefix caching +
+  multi-tenancy + pipelining is token-exact to the single engine with
+  the summed per-replica fetch budget intact, and a chaos-killed
+  replica's queued work re-dispatches token-identically with the
+  ``DispatchLedger`` verifying exactly-once delivery;
 - ``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` succeeds in a
   subprocess (the tier-1 wiring for the end-to-end smoke), and the
-  ``--chaos`` arm exercises the fault paths end to end.
+  ``--chaos`` / ``--router`` arms exercise the fault and fleet paths
+  end to end.
 """
 
 import json
@@ -1992,4 +2001,219 @@ def test_serve_selftest_pipeline_subprocess(tmp_path):
     assert receipt["n_chunks"] >= 1
     assert receipt["pipeline_requests"] >= 3
     assert receipt["pipeline_host_fetches"] >= 1
+    assert load_receipt(json_path)["ok"] is True
+
+
+# ---------------------------------------------- fleet router (ISSUE 12)
+
+def _tree_identical(a, b):
+    """Byte-identical pytrees: same structure, dtypes, shapes, values."""
+    la, sa = jax.tree_util.tree_flatten(a)
+    lb, sb = jax.tree_util.tree_flatten(b)
+    return sa == sb and all(
+        x.dtype == y.dtype and x.shape == y.shape and bool((x == y).all())
+        for x, y in zip(la, lb)
+    )
+
+
+def test_fleet_router_n1_transparency(model_params):
+    """The router-off parity pin at the fleet level: ``FleetRouter``
+    over ONE real engine is a transparent wrapper — byte-identical
+    completions AND slot-state trees AND compiled-program counts vs
+    driving the same engine directly, with the fetch budget unchanged
+    (the router adds pure host bookkeeping, zero device work)."""
+    from pytorch_distributed_training_tutorials_tpu.serve import FleetRouter
+
+    model, params = model_params
+    reqs = [(_prompt(7000 + i, p), m)
+            for i, (p, m) in enumerate([(5, 8), (9, 6), (13, 10)])]
+
+    def run(routed):
+        engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=4)
+        front = FleetRouter([engine]) if routed else engine
+        calls = {"n": 0}
+        real_get = jax.device_get
+
+        def counting(x):
+            calls["n"] += 1
+            return real_get(x)
+
+        jax.device_get = counting
+        try:
+            ids = [front.submit(Request(prompt=p, max_new_tokens=m, seed=i))
+                   for i, (p, m) in enumerate(reqs)]
+            done = {c.request_id: c for c in front.run_until_idle()}
+        finally:
+            jax.device_get = real_get
+        return engine, front, [done[i] for i in ids], calls["n"]
+
+    eng_d, _, out_d, fetches_d = run(False)
+    eng_r, fr, out_r, fetches_r = run(True)
+    assert [c.tokens for c in out_r] == [c.tokens for c in out_d]
+    assert [c.finish_reason for c in out_r] == [
+        c.finish_reason for c in out_d
+    ]
+    for (p, m), c in zip(reqs, out_r):
+        assert c.tokens == _reference(model, params, p, m)
+    assert _tree_identical(eng_r._state, eng_d._state)
+    assert eng_r._chain._cache_size() == eng_d._chain._cache_size()
+    assert eng_r._prefill._cache_size() == eng_d._prefill._cache_size()
+    assert fetches_r == fetches_d
+    assert fetches_r == eng_r.n_chains + eng_r.n_prefills + eng_r.n_splices
+    assert fr.ledger.verify() == []
+    stats = fr.router_stats()
+    assert stats["n_replicas"] == 1
+    assert stats["redispatched"] == 0 and stats["hedged"] == 0
+    assert fr.replica_states() == ["healthy"]
+
+
+@pytest.mark.slow
+def test_fleet_router_composed_prefix_tenants_pipeline(model_params):
+    """A 2-replica fleet where each replica runs the FULL serving stack
+    (prefix cache + adapter bank + depth-2 pipeline + chunked prefill)
+    serves a mixed-tenant shared-prefix stream token-exact to one
+    identically-configured engine; the summed per-replica fetch budget
+    stays exactly chains + prefills + splices, and the ledger verifies
+    exactly-once delivery.
+
+    Slow-marked under the tier-1 time-budget policy (ROADMAP): this is
+    the everything-composed heavyweight; its component contracts stay
+    in the fast tier via the N=1 transparency and chaos-kill tests."""
+    from pytorch_distributed_training_tutorials_tpu.serve import FleetRouter
+
+    model, params = model_params
+    shared = _prompt(7100, 12)
+    reqs = [(shared + _prompt(7101 + i, 5), 5 + (i % 3), i % 3)
+            for i in range(6)]
+    kw = dict(
+        n_slots=2, tokens_per_launch=8, pipeline_depth=2, prefill_chunk=8,
+        prefix_cache_bytes=16 * 1024 * 1024,
+    )
+
+    def make_engine():
+        return ServeEngine(model, params, adapter_bank=_lora_bank(model),
+                           **kw)
+
+    # reference arm: one engine, the same composed configuration
+    single = make_engine()
+    ids = [single.submit(Request(prompt=p, max_new_tokens=m, adapter=a,
+                                 seed=i))
+           for i, (p, m, a) in enumerate(reqs)]
+    ref = {c.request_id: c for c in single.run_until_idle()}
+
+    engines = [make_engine() for _ in range(2)]
+    fr = FleetRouter(engines)
+    calls = {"n": 0}
+    real_get = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real_get(x)
+
+    jax.device_get = counting
+    try:
+        gids = [fr.submit(Request(prompt=p, max_new_tokens=m, adapter=a,
+                                  seed=i))
+                for i, (p, m, a) in enumerate(reqs)]
+        done = {c.request_id: c for c in fr.run_until_idle()}
+    finally:
+        jax.device_get = real_get
+    assert [done[g].tokens for g in gids] == [ref[r].tokens for r in ids]
+    assert fr.ledger.verify() == []
+    assert calls["n"] == sum(
+        e.n_chains + e.n_prefills + e.n_splices for e in engines
+    )
+    # affinity actually spread the stream: the shared-prefix family all
+    # lands on one replica (that IS the point — splice hits), but the
+    # whole fleet still saw work through it
+    assert sum(e.n_prefills + e.n_splices for e in engines) == len(reqs)
+
+
+def test_fleet_router_chaos_kill_redispatch_token_exact(model_params):
+    """The ISSUE 12 acceptance pin on REAL engines: a chaos-killed
+    replica's queued requests re-dispatch to survivors and finish
+    byte-identical to a fault-free fleet run (same template + same seed
+    => same greedy tokens — the re-dispatch is invisible in outputs);
+    in-flight work on the dead replica completes ``"replica_dead"``;
+    the ledger proves exactly-once; the killed engine's device work
+    stops at the kill."""
+    from pytorch_distributed_training_tutorials_tpu.serve import (
+        FleetRouter,
+        affinity_hash,
+    )
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import FleetChaosConfig
+
+    model, params = model_params
+    n_replicas = 2
+    base = _prompt(7200, 6)
+    # one prompt family -> one affine replica holding in-flight AND
+    # queued work when it dies (n_slots=1 keeps the rest queued)
+    reqs = [(base, 12), (base, 12), (base, 12)]
+    target = affinity_hash(base, adapter=0, depth=16) % n_replicas
+
+    def run(chaos):
+        engines = [
+            ServeEngine(model, params, n_slots=1, tokens_per_launch=4,
+                        max_queue=8)
+            for _ in range(n_replicas)
+        ]
+        fr = FleetRouter(engines, chaos=chaos)
+        gids = [fr.submit(Request(prompt=p, max_new_tokens=m, seed=i))
+                for i, (p, m) in enumerate(reqs)]
+        done = {c.request_id: c for c in fr.run_until_idle()}
+        return fr, engines, [done[g] for g in gids]
+
+    fr_ok, _, out_ok = run(None)
+    assert [c.finish_reason for c in out_ok] == ["length"] * len(reqs)
+
+    fr_x, engines_x, out_x = run(
+        FleetChaosConfig(kill_replica=target, kill_at_chain=1)
+    )
+    assert fr_x.ledger.verify() == []
+    assert len(out_x) == len(reqs)  # exactly one completion per request
+    assert fr_x.replica_states()[target] == "dead"
+    reasons = [c.finish_reason for c in out_x]
+    assert "replica_dead" in reasons  # the in-flight casualty
+    assert reasons.count("length") == len(reqs) - reasons.count(
+        "replica_dead"
+    )
+    # every survivor is byte-identical to its fault-free twin
+    for ok, x in zip(out_ok, out_x):
+        if x.finish_reason == "length":
+            assert x.tokens == ok.tokens
+    assert fr_x.ledger.n_redispatched >= 1  # queued work actually moved
+    # the dead replica is never stepped again: its chain counter froze
+    # at (or just past) the kill threshold
+    assert engines_x[target].n_chains <= 2
+
+
+@pytest.mark.slow
+def test_serve_selftest_router_subprocess(tmp_path):
+    """``--selftest --router`` — the ISSUE 12 arm: a 3-replica fleet of
+    real engines serves the staggered stream byte-identical to the
+    single engine, then replays it with a chaos-killed replica —
+    exactly-once delivery, token-exact re-dispatch, dead-replica
+    accounting, and the summed fetch budget all counted into the
+    receipt."""
+    from pytorch_distributed_training_tutorials_tpu.obs import load_receipt, validate_receipt
+
+    json_path = str(tmp_path / "selftest_router.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_training_tutorials_tpu.serve", "--selftest",
+         "--router", "--json", json_path],
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+        env=os.environ.copy(),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    receipt = json.loads(out.stdout.strip().splitlines()[-1])
+    assert receipt["ok"] is True, receipt.get("problems")
+    assert validate_receipt(receipt, kind="serve_selftest") == []
+    assert receipt["router_fleet_exact"] is True
+    assert receipt["router_n_replicas"] == 3
+    assert receipt["router_replicas_dead"] == 1
+    assert receipt["router_redispatched"] + receipt[
+        "router_replica_dead_completions"
+    ] >= 1
+    assert receipt["router_requests"] >= 3
+    assert receipt["router_host_fetches_chaos"] >= 1
     assert load_receipt(json_path)["ok"] is True
