@@ -311,6 +311,29 @@ def main():
         "inject between chains",
     )
     ap.add_argument(
+        "--paged", action="store_true",
+        help="for --server: paged KV cache (ISSUE 13) — the slot caches "
+        "become one shared page pool + per-slot page tables, admission "
+        "counts PAGES not slots, and prefix hits pin shared pages "
+        "copy-free. The receipt gains hbm_high_water_bytes (the honest "
+        "peak pool claim) and the pages_* counters. Real-chip recipe "
+        "(deferred tunnel debt): --preset 1b --max_seq_len 4096 "
+        "--server --paged",
+    )
+    ap.add_argument(
+        "--page-size", type=int, default=64, dest="page_size",
+        help="for --server --paged: tokens per KV page (must divide "
+        "max_seq_len)",
+    )
+    ap.add_argument(
+        "--pool-pages", type=int, default=0, dest="pool_pages",
+        help="for --server --paged: pages in the pool; 0 (default) "
+        "sizes it to slots * window / page_size — the whole-slot HBM "
+        "footprint. Set it LOWER to oversubscribe slots against HBM "
+        "(requests queue for pages; ones that can never fit shed at "
+        "submit)",
+    )
+    ap.add_argument(
         "--replicas", type=int, default=1,
         help="for --server: serve through a FleetRouter over N replica "
         "engines (N KV-cache footprints in HBM — the same checkpoint "
@@ -612,6 +635,17 @@ def _reset_serving_counters(engine) -> None:
         engine.prefix.hits = engine.prefix.misses = 0
 
 
+def _paged_kwargs(args, window: int) -> dict:
+    """ServeEngine paged-geometry kwargs from the CLI flags. --pool-pages
+    0 sizes the pool to the whole-slot footprint (slots * window worth of
+    pages) — same HBM, page-granular accounting; a smaller explicit pool
+    oversubscribes slots against HBM."""
+    if not args.paged:
+        return {}
+    pool = args.pool_pages or args.slots * window // args.page_size
+    return dict(paged=True, page_size=args.page_size, pool_pages=pool)
+
+
 def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
     """The ``--server --replicas N`` leg (ISSUE 12): the same request
     stream through a :class:`...serve.FleetRouter` over N replica
@@ -698,6 +732,7 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
             pipeline_depth=args.pipeline_depth,
             prefill_chunk=args.prefill_chunk,
             flight=FlightRecorder(capacity=4096, t0=t0),
+            **_paged_kwargs(args, window),
         )
         for _ in range(args.replicas)
     ]
@@ -898,6 +933,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         flight=flight,
         pipeline_depth=args.pipeline_depth,
         prefill_chunk=args.prefill_chunk,
+        **_paged_kwargs(args, window),
     )
     rng = np.random.Generator(np.random.PCG64(11))
     # one shared token family: request i's prompt = shared[:k] + tail,

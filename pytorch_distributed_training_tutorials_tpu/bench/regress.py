@@ -114,6 +114,13 @@ CONFIG_FIELDS = (
     # deliberately — outcomes of the injected faults and traffic, not
     # configuration of the experiment
     "n_replicas", "hedge", "affinity", "qps",
+    # paged KV cache (ISSUE 13): the pool geometry changes what a tok/s
+    # or HBM number MEANS (gathered page reads vs whole-slot reads,
+    # admission by pages vs slots), so paged and whole-slot rounds are
+    # different experiments; the occupancy counters (pages_high_water,
+    # pages_shares, pages_sheds, hbm_high_water_bytes) stay out
+    # deliberately — outcomes of the traffic, not configuration
+    "paged", "page_size", "pool_pages",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

@@ -95,6 +95,20 @@ class TransformerConfig:
     # the scores AND the context einsum — so the only loss is the storage
     # rounding itself; int8 rounds harder than bf16).
     kv_cache_dtype: "jnp.dtype | None" = None
+    # Paged KV decode (serve/pages.py, ISSUE 13): > 0 restructures the
+    # DECODE cache as one shared (kv_pages, kv_page_size, heads, head_dim)
+    # pool per layer plus a per-row int32 page-table vector riding the
+    # cache tree as DATA — reads gather whole pages by table entry
+    # (jnp.take, mode="fill"), writes scatter through the table
+    # (mode="drop"; the sentinel id kv_pages maps unbacked logical pages
+    # out of range so their writes vanish). Page ids are traced data,
+    # never Python control flow — the adapter-bank discipline. Governs
+    # decode=True only; prefill keeps the classic whole-window batch-1
+    # cache (serve/engine.py prefills unpaged and scatters the result
+    # into the pool via slots.write_slot_paged). 0 = feature off:
+    # programs and cache trees byte-identical to a pre-paging build.
+    kv_pages: int = 0
+    kv_page_size: int = 0
     # Tensor-parallel int8 serving: a mesh with a 'model' axis routes every
     # quantized matmul through the shard_map-wrapped kernel
     # (ops.quant.int8_matmul_tp) in the Megatron column/row layout; q/scale
@@ -272,6 +286,54 @@ def _store_decode_kv(var, val: jax.Array, pos: jax.Array) -> None:
         var.value = var.value.at[rows, cols].set(val, mode="drop")
 
 
+def _gather_pages(pool: jax.Array, table: jax.Array) -> jax.Array:
+    """Materialize each row's logical window from the shared page pool.
+
+    ``pool`` is ``(kv_pages, page_size, ...)``; ``table`` is the per-row
+    page-table ``(B, P)`` of int32 page ids (``P * page_size`` = the
+    logical window). Returns ``(B, P * page_size, ...)`` — exactly the
+    array the whole-slot decode path reads, which is why paged attention
+    is bitwise the unpaged one: the gather feeds the SAME
+    grouped_masked_attention over the SAME validity mask, and unbacked
+    entries (the sentinel id ``kv_pages``, out of range) fill with 0.0,
+    which the mask already excludes (a masked column contributes an
+    exact softmax zero — see the decode-branch comment below).
+
+    Page ids are traced DATA: ``jnp.take`` with ``mode="fill"``, never a
+    Python branch (graftcheck ``traced-control-flow`` has the fixture
+    pair pinning this idiom)."""
+    out = jnp.take(pool, table, axis=0, mode="fill", fill_value=0)
+    b, p = table.shape
+    return out.reshape((b, p * pool.shape[1]) + pool.shape[2:])
+
+
+def _store_paged_kv(var, table: jax.Array, val: jax.Array, pos) -> None:
+    """Paged twin of :func:`_store_decode_kv`: write row r's token s of
+    ``val`` (B, S, ...) into pool variable ``var`` (kv_pages, page_size,
+    ...) at the page/offset the row's ``table`` (B, P) maps logical
+    position ``pos[r] + s`` to.
+
+    Logical positions past the table (bucket padding beyond the window)
+    and positions whose table entry is the sentinel ``kv_pages`` (parked
+    or unbacked rows) both resolve to an out-of-range page id and DROP —
+    the same safety rule as the unpaged scatter. The engine parks a
+    finished slot by sentinel-filling its table row, so an inactive
+    slot's junk writes land nowhere even after its pages are recycled."""
+    val = val.astype(var.value.dtype)
+    s = val.shape[1]
+    n_pages, page_size = var.value.shape[0], var.value.shape[1]
+    p_cap = table.shape[1]
+    # pos is (B,) by construction (paged decode always runs slot-indexed)
+    cols = pos[:, None] + jnp.arange(s)  # (B, S) logical positions
+    p_idx = cols // page_size
+    offs = cols % page_size
+    ids = jnp.take_along_axis(
+        table, jnp.clip(p_idx, 0, p_cap - 1), axis=1
+    )
+    ids = jnp.where(p_idx < p_cap, ids, n_pages)  # past-window -> OOB
+    var.value = var.value.at[ids, offs].set(val, mode="drop")
+
+
 def _is_cache_index(path) -> bool:
     """Is this tree_map_with_path leaf a ``cache_index`` counter?"""
     key = path[-1]
@@ -419,6 +481,58 @@ class Attention(nn.Module):
             )
         return cached_k, cached_v, idx, k_scale, v_scale
 
+    def _paged_cache_vars(self, b: int, k_dtype, v_dtype):
+        """Paged twin of :meth:`_cache_vars` (``cfg.kv_pages`` > 0,
+        decode only): K/V live in ONE shared ``(kv_pages, kv_page_size,
+        kv_heads, head_dim)`` pool with NO batch axis — only the
+        ``page_table`` ``(b, P)`` (P = max_seq_len // kv_page_size,
+        sentinel-initialized to the OOB id ``kv_pages``) and the per-row
+        ``cache_index`` ``(b,)`` carry batch. That asymmetry is the
+        point: a batch-1 splice/prefill apply writes DIRECTLY into the
+        shared pool through its own one-row table (serve/engine.py), so
+        prefix-cache hits pin pages instead of copying segments. int8
+        storage carries per-(page, offset, head) float32 scale pools —
+        the same per-token-per-head absmax scheme as the unpaged cache
+        (``_quantize_kv``), just paged storage."""
+        cfg = self.cfg
+        h, d = cfg.kv_heads, cfg.head_dim
+        if cfg.kv_cache_dtype is not None:
+            k_dtype = v_dtype = cfg.kv_cache_dtype
+        npages, psize = cfg.kv_pages, cfg.kv_page_size
+        if psize < 1 or cfg.max_seq_len % psize:
+            raise ValueError(
+                f"kv_page_size {psize} must be >= 1 and divide "
+                f"max_seq_len {cfg.max_seq_len}"
+            )
+        cached_k = self.variable(
+            "cache", "paged_key",
+            jnp.zeros, (npages, psize, h, d), k_dtype,
+        )
+        cached_v = self.variable(
+            "cache", "paged_value",
+            jnp.zeros, (npages, psize, h, d), v_dtype,
+        )
+        n_tables = cfg.max_seq_len // psize
+        table = self.variable(
+            "cache", "page_table",
+            lambda: jnp.full((b, n_tables), npages, jnp.int32),
+        )
+        idx = self.variable(
+            "cache", "cache_index",
+            lambda: jnp.zeros((b,), jnp.int32),
+        )
+        k_scale = v_scale = None
+        if k_dtype == jnp.int8:
+            k_scale = self.variable(
+                "cache", "paged_key_scale",
+                jnp.zeros, (npages, psize, h), jnp.float32,
+            )
+            v_scale = self.variable(
+                "cache", "paged_value_scale",
+                jnp.zeros, (npages, psize, h), jnp.float32,
+            )
+        return cached_k, cached_v, table, idx, k_scale, v_scale
+
     @nn.compact
     def __call__(
         self, x, decode: bool = False, prefill: bool = False,
@@ -471,7 +585,52 @@ class Attention(nn.Module):
                 x, adapter_ids
             ).reshape(v.shape)
 
-        if decode:
+        if decode and cfg.kv_pages:
+            # paged decode (cfg.kv_pages > 0): identical math to the
+            # unpaged branch below — the page gather materializes the
+            # SAME (B, max_seq_len, kv, d) window the whole-slot cache
+            # stores, then the SAME rope/mask/attention runs over it, so
+            # paged greedy decode is bitwise the unpaged one. Only the
+            # storage moves: K/V land in the shared pool through the
+            # per-row page table (traced data — _store_paged_kv /
+            # _gather_pages document the sentinel/drop safety rules).
+            b, s = x.shape[0], x.shape[1]
+            cached_k, cached_v, table, idx, k_scale, v_scale = (
+                self._paged_cache_vars(b, k_raw.dtype, v.dtype)
+            )
+            pos = idx.value  # (B,) — paged decode is always slot-indexed
+            tbl = table.value
+            q = apply_rope(q_raw, cfg.rope_theta, offset=pos)
+            k = apply_rope(k_raw, cfg.rope_theta, offset=pos)
+            if k_scale is not None:  # int8 pool: store q + scale
+                k_q, k_s = _quantize_kv(k)
+                v_q, v_s = _quantize_kv(v)
+                _store_paged_kv(cached_k, tbl, k_q, pos)
+                _store_paged_kv(cached_v, tbl, v_q, pos)
+                _store_paged_kv(k_scale, tbl, k_s, pos)
+                _store_paged_kv(v_scale, tbl, v_s, pos)
+                k_read = _dequantize_kv(
+                    _gather_pages(cached_k.value, tbl),
+                    _gather_pages(k_scale.value, tbl), k.dtype,
+                )
+                v_read = _dequantize_kv(
+                    _gather_pages(cached_v.value, tbl),
+                    _gather_pages(v_scale.value, tbl), v.dtype,
+                )
+            else:
+                _store_paged_kv(cached_k, tbl, k, pos)
+                _store_paged_kv(cached_v, tbl, v, pos)
+                k_read = _gather_pages(cached_k.value, tbl)
+                v_read = _gather_pages(cached_v.value, tbl)
+            idx.value = pos + s
+            qpos = pos[..., None] + jnp.arange(s)
+            valid = (
+                jnp.arange(cfg.max_seq_len) <= qpos[..., :, None]
+            )  # (B, S, max_len): per-slot depths, like the unpaged path
+            out = grouped_masked_attention(
+                q, k_read, v_read, valid[:, None, :, :]
+            )
+        elif decode:
             # incremental decoding: S tokens in (S == 1 for the classic
             # generate()/serve step; S > 1 is a CHUNKED continuation — the
             # suffix prefill of a prefix-cache hit, serve/engine.py), KV

@@ -73,6 +73,8 @@ EVENT_KINDS = frozenset({
     "replica_health",    # fleet router health transition (ISSUE 12)
     "redispatch",        # router moved a request off a dead/draining replica
     "hedge",             # router duplicated a straggler onto a second replica
+    "pool_shed",         # paged KV: submit rejected, request > whole pool
+    "page_cow",          # paged KV: copy-on-write split of a shared page
 })
 
 # Faults trigger an auto-dump when a dump_path is configured.

@@ -14,6 +14,10 @@ Public surface:
 - :func:`.slots.bucket_len` / :func:`.slots.init_slot_state` /
   :func:`.slots.write_slot` — the slot-state building blocks (exposed
   for tests and for engines over non-TransformerLM models);
+- :class:`.pages.PagePool` / :class:`.pages.PoolExhausted` — the
+  jax-free page-pool allocator behind ``ServeEngine(paged=True)``
+  (ISSUE 13): fixed pages, refcounted prefix sharing, synchronous
+  admission backpressure;
 - :class:`.prefix.PrefixIndex` / :class:`.prefix.Segment` — the
   jax-free radix prefix index behind ``ServeEngine(prefix_cache_bytes=
   ...)``: shared-prompt KV reuse via retained cache segments
@@ -43,6 +47,8 @@ _LAZY_EXPORTS = {
     "DispatchLedger": "pytorch_distributed_training_tutorials_tpu.serve.router",
     "FleetRouter": "pytorch_distributed_training_tutorials_tpu.serve.router",
     "affinity_hash": "pytorch_distributed_training_tutorials_tpu.serve.router",
+    "PagePool": "pytorch_distributed_training_tutorials_tpu.serve.pages",
+    "PoolExhausted": "pytorch_distributed_training_tutorials_tpu.serve.pages",
     "PrefixIndex": "pytorch_distributed_training_tutorials_tpu.serve.prefix",
     "Segment": "pytorch_distributed_training_tutorials_tpu.serve.prefix",
     "Completion": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",

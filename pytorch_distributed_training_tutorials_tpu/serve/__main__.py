@@ -62,8 +62,18 @@ replica chaos-killed mid-stream — the DispatchLedger must verify
 exactly-once (no accepted request lost or completed twice),
 re-dispatched requests must stay byte-identical to the fault-free leg,
 and the SUMMED per-replica fetch budget stays chains + prefills +
-splices. Prints exactly one JSON line (a ``graft-receipt/v1``
-envelope) and exits non-zero on any failure.
+splices. A ninth (``--paged``) arm replays a short+long mixed stream at
+OVERSUBSCRIBED slot count (``n_slots * window > pool_pages *
+page_size``) through a ``paged=True`` engine (ISSUE 13): greedy tokens
+must stay byte-identical to the whole-slot engine (pages are invisible
+in the outputs), the fetch budget is unchanged, a request that can
+never fit the pool must shed synchronously at submit
+(:class:`..serve.pages.PoolExhausted`), and an overlapping-prompt leg
+with the prefix cache ON must show page SHARES (retained prefix pages
+seeding new requests copy-free) while staying byte-identical to the
+paged cache-off leg. ``page_stats()`` (occupancy high-water, shares,
+sheds) rides into the receipt. Prints exactly one JSON line (a
+``graft-receipt/v1`` envelope) and exits non-zero on any failure.
 """
 
 from __future__ import annotations
@@ -77,7 +87,7 @@ import sys
 def selftest(json_path: str | None = None, spec_k: int = 2,
              adapters: int = 3, chaos: bool = False,
              flight: bool = False, pipeline: bool = False,
-             router: bool = False) -> dict:
+             router: bool = False, paged: bool = False) -> dict:
     import math
     import tempfile
 
@@ -645,6 +655,137 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
         }
 
     # ------------------------------------------------------------------
+    # paged arm (--paged, ISSUE 13): a short+long mixed stream at
+    # OVERSUBSCRIBED slot count (3 slots x 64-token windows = 192
+    # claimable tokens over a 6-page x 8-token pool = 48) — admission is
+    # by PAGES, tokens must stay byte-identical to the whole-slot
+    # engine, the fetch budget is unchanged, a request that can never
+    # fit the pool sheds synchronously at submit, and a prefix-cache
+    # leg must show page SHARES (retained prefix pages seeding new
+    # requests copy-free) while staying byte-identical to cache-off
+    # ------------------------------------------------------------------
+    paged_fields: dict = {}
+    if paged:
+        from pytorch_distributed_training_tutorials_tpu.serve import PoolExhausted
+
+        paged_reqs = []
+        for i, (p_len, max_new) in enumerate(
+            [(3, 9), (17, 12), (5, 5), (12, 6), (2, 17), (9, 14)]
+        ):
+            rng, sub = jax.random.split(rng)
+            paged_reqs.append((
+                jax.device_get(jax.random.randint(
+                    sub, (p_len,), 0, cfg.vocab_size
+                )).tolist(),
+                max_new,
+            ))
+
+        def run_paged_stream(reqs, prefix_bytes=0, page_kw=None):
+            eng = ServeEngine(
+                model, params, n_slots=3, tokens_per_launch=8,
+                prefix_cache_bytes=prefix_bytes, **(page_kw or {}),
+            )
+            count = {"n": 0}
+
+            def counting(x):
+                count["n"] += 1
+                return real_get(x)
+
+            jax.device_get = counting
+            try:
+                out = {}
+                pending = list(reqs)
+                for toks, max_new in pending[:3]:
+                    eng.submit(Request(prompt=toks, max_new_tokens=max_new))
+                pending = pending[3:]
+                while not eng.idle or pending:
+                    while pending:
+                        toks, max_new = pending[0]
+                        try:
+                            eng.submit(Request(
+                                prompt=toks, max_new_tokens=max_new
+                            ))
+                            pending.pop(0)
+                        except QueueFull:
+                            break
+                    for c in eng.step():
+                        out[c.request_id] = c.tokens
+            finally:
+                jax.device_get = real_get
+            return eng, out, count["n"]
+
+        geometry = dict(paged=True, page_size=8, pool_pages=6)
+        eng_ws, toks_ws, _ = run_paged_stream(paged_reqs)
+        eng_pg, toks_pg, fetches_pg = run_paged_stream(
+            paged_reqs, page_kw=geometry
+        )
+        paged_exact = toks_pg == toks_ws
+        if not paged_exact:
+            problems.append(
+                f"paged engine changed greedy tokens: {toks_pg} != "
+                f"{toks_ws}"
+            )
+        pg_budget = eng_pg.n_chains + eng_pg.n_prefills
+        if fetches_pg > pg_budget:
+            problems.append(
+                f"paged arm: {fetches_pg} host fetches > {pg_budget} "
+                f"({eng_pg.n_chains} chains + {eng_pg.n_prefills} "
+                f"prefills)"
+            )
+        # a request that can never fit the 48-token pool (but WOULD fit
+        # the 64-token window) must shed synchronously at submit
+        paged_shed = False
+        try:
+            eng_pg.submit(Request(
+                prompt=paged_reqs[1][0] * 2, max_new_tokens=30
+            ))
+            problems.append("pool-exceeding request admitted at submit")
+        except PoolExhausted:
+            paged_shed = True
+        pgstats = eng_pg.stats("pages")
+        if pgstats.get("pages_high_water", 0) < 1:
+            problems.append(f"paged arm: pool never allocated: {pgstats}")
+        if pgstats.get("pages_in_use", -1) != 0:
+            problems.append(
+                f"paged arm: {pgstats.get('pages_in_use')} pages leaked "
+                f"after the stream drained"
+            )
+        # prefix leg: the overlapping stream through a paged cache-on
+        # engine — tokens must match the (whole-slot) cache-off arm, and
+        # the retained prefix pages must be SHARED, not copied
+        eng_px, toks_px, fetches_px = run_paged_stream(
+            overlap_reqs, prefix_bytes=16 * 1024 * 1024,
+            page_kw=dict(paged=True, page_size=8, pool_pages=16),
+        )
+        paged_prefix_exact = toks_px == toks_off
+        if not paged_prefix_exact:
+            problems.append(
+                f"paged prefix leg changed greedy tokens: {toks_px} != "
+                f"{toks_off}"
+            )
+        px_budget = eng_px.n_chains + eng_px.n_prefills + eng_px.n_splices
+        if fetches_px > px_budget:
+            problems.append(
+                f"paged prefix leg: {fetches_px} host fetches > "
+                f"{px_budget} (chains + prefills + splices)"
+            )
+        pxstats = eng_px.stats("pages")
+        if pxstats.get("pages_shares", 0) < 1:
+            problems.append(
+                f"paged prefix leg: no page shares on an overlapping "
+                f"stream: {pxstats}"
+            )
+        paged_fields = {
+            "paged_requests": len(paged_reqs),
+            "paged_token_exact": paged_exact,
+            "paged_host_fetches": fetches_pg,
+            "paged_shed_ok": paged_shed,
+            "paged_prefix_token_exact": paged_prefix_exact,
+            "paged_prefix_shares": pxstats.get("pages_shares", 0),
+            **pgstats,
+        }
+
+    # ------------------------------------------------------------------
     # router arm (--router, ISSUE 12): a 3-replica fleet of REAL engines
     # behind the FleetRouter. Leg 1 (fault-free) pins fleet == single
     # engine: every request's greedy tokens byte-identical to the base
@@ -998,6 +1139,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             **astats,
             **flight_fields,
             **pipeline_fields,
+            **paged_fields,
             **router_fields,
             **fault_fields,
             "problems": problems,
@@ -1058,6 +1200,13 @@ def main(argv: list[str] | None = None) -> int:
         "token-exact re-dispatch, and the summed per-replica fetch "
         "budget asserted (ISSUE 12)",
     )
+    parser.add_argument(
+        "--paged", action="store_true",
+        help="also run the paged-KV arm: an oversubscribed mixed stream "
+        "through a page-pool engine, token-identical to whole-slot with "
+        "the same fetch budget, PoolExhausted shed at submit, and "
+        "copy-free page sharing under the prefix cache (ISSUE 13)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -1079,7 +1228,7 @@ def main(argv: list[str] | None = None) -> int:
     receipt = selftest(args.json, spec_k=args.spec_k,
                        adapters=args.adapters, chaos=args.chaos,
                        flight=args.flight, pipeline=args.pipeline,
-                       router=args.router)
+                       router=args.router, paged=args.paged)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

@@ -151,6 +151,10 @@ from pytorch_distributed_training_tutorials_tpu.models.sampling import (
 from pytorch_distributed_training_tutorials_tpu.models.transformer import (
     rewind_cache_index,
 )
+from pytorch_distributed_training_tutorials_tpu.serve.pages import (
+    PagePool,
+    PoolExhausted,
+)
 from pytorch_distributed_training_tutorials_tpu.serve.prefix import PrefixIndex
 from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
     Completion,
@@ -158,12 +162,15 @@ from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
     Request,
 )
 from pytorch_distributed_training_tutorials_tpu.serve.slots import (
+    _POOL_TO_FLAT,
+    _leaf_name,
     bucket_len,
     extract_segment,
     init_slot_state,
     seed_cache,
     tree_nbytes,
     write_slot,
+    write_slot_paged,
     zero_cache,
 )
 from pytorch_distributed_training_tutorials_tpu.utils import chaos as chaos_lib
@@ -174,7 +181,8 @@ class _Active:
     segment this slot was spliced from (released at completion);
     ``ttft_s`` is submit-to-first-token wall time."""
 
-    __slots__ = ("request", "tokens", "remaining", "segment", "ttft_s")
+    __slots__ = ("request", "tokens", "remaining", "segment", "ttft_s",
+                 "pages")
 
     def __init__(self, request: Request, first_token: int):
         self.request = request
@@ -182,6 +190,9 @@ class _Active:
         self.remaining = request.max_new_tokens - 1
         self.segment = None
         self.ttft_s = 0.0
+        # paged engines (ISSUE 13): pool page ids this slot holds one
+        # reference to each — released when the slot parks
+        self.pages: list[int] = []
 
 
 class _InFlight:
@@ -208,7 +219,7 @@ class _PendingPrefill:
     until the final chunk, so decode chains treat it as inactive."""
 
     __slots__ = ("request", "slot", "cache1", "prompt", "aid", "done",
-                 "depth", "segment", "grow", "pkey")
+                 "depth", "segment", "grow", "pkey", "pages")
 
     def __init__(self, request: Request, slot: int):
         self.request = request
@@ -221,6 +232,9 @@ class _PendingPrefill:
         self.segment = None
         self.grow = False
         self.pkey: list[int] = []
+        # paged engines (ISSUE 13): pages pre-allocated for the slot at
+        # chunking start (all fresh — chunked prompts don't share)
+        self.pages: list[int] = []
 
 
 class ServeEngine:
@@ -263,11 +277,26 @@ class ServeEngine:
         flight=None,
         pipeline_depth: int = 1,
         prefill_chunk: int = 0,
+        paged: bool = False,
+        page_size: int = 0,
+        pool_pages: int = 0,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if tokens_per_launch < 1:
             raise ValueError("tokens_per_launch must be >= 1")
+        # paged KV (ISSUE 13): off = byte-identical state tree + compiled
+        # programs to the whole-slot engine (the geometry kwargs must not
+        # be set, so an off engine can never half-configure a pool)
+        if paged:
+            if page_size < 1 or pool_pages < 1:
+                raise ValueError(
+                    "paged=True needs page_size >= 1 and pool_pages >= 1"
+                )
+        elif page_size or pool_pages:
+            raise ValueError(
+                "page_size/pool_pages require paged=True"
+            )
         if speculative_k < 0:
             raise ValueError("speculative_k must be >= 0")
         if pipeline_depth < 1:
@@ -313,6 +342,38 @@ class ServeEngine:
         self.n_slots = n_slots
         self.tokens_per_launch = tokens_per_launch
         self.window = int(model.cfg.max_seq_len)
+        # paged KV decode (ISSUE 13): the DECODE-side model reads/writes
+        # K/V through a shared page pool + per-slot page tables
+        # (cfg.kv_pages/kv_page_size — models/transformer.py), so slot
+        # count decouples from window size: n_slots * window may exceed
+        # pool_pages * page_size, with admission backpressure
+        # (PoolExhausted) when a request can never fit. Prefill/chunk
+        # programs keep the UNPAGED batch-1 layout (self.model) and the
+        # scatter into the pool happens in write_slot_paged. When off,
+        # _dec_model IS self.model, so every chain jaxpr below is
+        # byte-identical to the whole-slot engine's.
+        self._paged = bool(paged)
+        self._page_size = int(page_size)
+        self._pool_pages = int(pool_pages)
+        if self._paged:
+            if self.window % self._page_size:
+                raise ValueError(
+                    f"page_size ({page_size}) must divide the window "
+                    f"({self.window}) so slot page tables have one "
+                    "fixed length"
+                )
+            self._pool = PagePool(pool_pages, page_size)
+            self._pages_per_slot = self.window // self._page_size
+            self._dec_model = type(model)(
+                cfg=dataclasses.replace(
+                    model.cfg, kv_pages=pool_pages,
+                    kv_page_size=page_size,
+                )
+            )
+        else:
+            self._pool = None
+            self._pages_per_slot = 0
+            self._dec_model = model
         # speculate-k: 0 = off (the engine then compiles byte-identical
         # programs to the pre-speculation one — no hist state, old chain)
         self._spec = speculative_k > 0
@@ -325,19 +386,42 @@ class ServeEngine:
         self.scheduler = FifoScheduler(self.window, max_queue=max_queue)
         self._slots: list[_Active | None] = [None] * n_slots
         self._state = init_slot_state(
-            model, params, n_slots,
+            self._dec_model, params, n_slots,
             history=self.window if self._spec else 0,
             adapters=self._adapters,
+            paged=self._pool_pages if self._paged else 0,
         )
         self._scan_layers = bool(getattr(model.cfg, "scan_layers", False))
+        if self._paged:
+            # per-page HBM footprint (all pool leaves / pool_pages) —
+            # page_stats()'s hbm_high_water_bytes and the prefix index's
+            # byte accounting both price pages with it. Host metadata
+            # only; tree_nbytes never touches the device.
+            pool_leaves = [
+                leaf for path, leaf in
+                jax.tree_util.tree_leaves_with_path(self._state["cache"])
+                if _leaf_name(path) in _POOL_TO_FLAT
+            ]
+            self._page_bytes = tree_nbytes(pool_leaves) // self._pool_pages
+        else:
+            self._page_bytes = 0
         self._temperature = float(temperature)
         self._top_k = int(top_k)
         self._top_p = float(top_p)
         # prefix cache: 0 bytes = off (the engine is then byte-identical
         # in behavior to the pre-prefix-cache one)
         self._retain = prefix_cache_bytes > 0
+        # paged engines hand the index an eviction hook so a segment's
+        # page refcounts flow back to the pool the moment the index
+        # drops it (the index stays jax-free and handle-agnostic: a
+        # paged handle is a tuple of page ids, not a device tree)
         self.prefix = (
-            PrefixIndex(prefix_cache_bytes) if self._retain else None
+            PrefixIndex(
+                prefix_cache_bytes,
+                on_evict=self._release_segment_pages if self._paged
+                else None,
+            )
+            if self._retain else None
         )
         self._min_hit_depth = int(min_hit_depth)
         # software pipeline (ISSUE 11): depth 1 = today's serial loop
@@ -400,7 +484,19 @@ class ServeEngine:
         # cache in place; CPU jit warns on donation (unsupported), so
         # only donate where it is real
         donate = (1,) if jax.default_backend() in ("tpu", "gpu") else ()
-        self._prefill = jax.jit(self._prefill_fn, donate_argnums=donate)
+        # classic and paged prefill/splice programs are MUTUALLY
+        # EXCLUSIVE per engine: an unpaged engine never constructs the
+        # paged twins (so its compiled-program census is byte-identical
+        # to the pre-paging engine), and a paged engine never constructs
+        # the whole-slot ones.
+        if self._paged:
+            self._prefill_paged = jax.jit(
+                self._prefill_paged_fn, donate_argnums=donate
+            )
+        else:
+            self._prefill = jax.jit(
+                self._prefill_fn, donate_argnums=donate
+            )
         # logit-poison chaos threads a traced chain-base scalar into the
         # chain (an EXTRA operand) — a separate wrapper keeps the
         # chaos-free jaxpr byte-identical to the pre-robustness one
@@ -421,10 +517,24 @@ class ServeEngine:
         # by NAME: for a jitted BOUND method argnums exclude self (unlike
         # the nn.remat(Block, static_argnums=...) idiom which counts it),
         # and names are unambiguous under both conventions.
-        self._splice = jax.jit(
-            self._splice_fn, static_argnames=("seg_len", "grow"),
-            donate_argnums=donate,
-        )
+        if self._paged:
+            # paged splice: no static argnames — shared/boundary page
+            # geometry rides as traced data (the row vector + the CoW
+            # src/dst pair, sentinel = no-op), so compiles stay one per
+            # suffix bucket. The parked-table program sentinels a slot's
+            # page-table row so chains dispatched after a completion
+            # never write through freed page ids.
+            self._splice_paged = jax.jit(
+                self._splice_paged_fn, donate_argnums=donate
+            )
+            self._paged_park = jax.jit(
+                self._paged_park_fn, donate_argnums=(0,) if donate else ()
+            )
+        else:
+            self._splice = jax.jit(
+                self._splice_fn, static_argnames=("seg_len", "grow"),
+                donate_argnums=donate,
+            )
         self._park = jax.jit(
             _park_slot, donate_argnums=(0,) if donate else ()
         )
@@ -435,19 +545,34 @@ class ServeEngine:
         # consumer), as is the slot state into the final splice.
         if self._chunk:
             self._chunk_zero = jax.jit(lambda: zero_cache(self._proto1))
-            self._chunk_seed = jax.jit(
-                lambda segment, depth: seed_cache(
-                    self._proto1, segment, depth
-                )
-            )
             self._chunk_step = jax.jit(
                 self._chunk_step_fn, donate_argnums=donate
             )
-            self._chunk_final = jax.jit(
-                self._chunk_final_fn,
-                static_argnames=("seg_len", "grow"),
-                donate_argnums=(1, 2) if donate else (),
-            )
+            if self._paged:
+                # paged seed: gather-COPY the donor's pages out of the
+                # live pool into the unpaged batch-1 side cache. Reads
+                # live state, so NEVER donated. The paged final chunk
+                # scatters the side cache into the slot's fresh pages
+                # (write_slot_paged) — side cache + slot state donated
+                # as in the classic twin.
+                self._chunk_seed_paged = jax.jit(
+                    self._chunk_seed_paged_fn
+                )
+                self._chunk_final_paged = jax.jit(
+                    self._chunk_final_paged_fn,
+                    donate_argnums=(1, 2) if donate else (),
+                )
+            else:
+                self._chunk_seed = jax.jit(
+                    lambda segment, depth: seed_cache(
+                        self._proto1, segment, depth
+                    )
+                )
+                self._chunk_final = jax.jit(
+                    self._chunk_final_fn,
+                    static_argnames=("seg_len", "grow"),
+                    donate_argnums=(1, 2) if donate else (),
+                )
 
     # ------------------------------------------------------------------
     # compiled programs (closures over model + static sampling params)
@@ -631,6 +756,263 @@ class ServeEngine:
             p_len, slot, seed, max_new, aid, kw, seg_len, grow,
         )
 
+    # -- paged twins (ISSUE 13) --------------------------------------------
+
+    def _prefill_paged_fn(self, params, state, tokens, row, p_len, slot,
+                          seed, max_new, aid=0):
+        """Paged-engine prefill: the forward is the SAME unpaged batch-1
+        prefill as :meth:`_prefill_fn` (self.model — prefill math never
+        pages), then :func:`.slots.write_slot_paged` scatters the full
+        window into the pool pages named by ``row`` (the slot's new page
+        table, sentinel-padded past its allocation) and installs the row
+        at ``slot``. The full-row scatter doubles as the recycled-page
+        sanitizer: any junk a completed slot's in-flight chains wrote
+        through these page ids dispatched BEFORE this program, so
+        program order guarantees the pages hold exactly this prompt's
+        K/V afterwards. No segment extraction — paged prefix retention
+        pins page ids host-side (``_insert_paged_segment``), zero device
+        work. ``row`` is a traced (pages_per_slot,) int32 vector; one
+        compile per prompt bucket, exactly like the classic twin."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
+        logits, upd = self.model.apply(
+            {"params": params}, tokens, prefill=True, mutable=["cache"],
+            last_pos=p_len - 1, **kw,
+        )
+        key = jax.random.PRNGKey(seed)
+        first, key = sample_logits(
+            logits[:, -1].astype(jnp.float32), key,
+            self._temperature, self._top_k, self._top_p,
+        )
+        cache = write_slot_paged(
+            state["cache"], upd["cache"], row, slot, p_len,
+            self._page_size, self._scan_layers,
+        )
+        new_state = {
+            "cache": cache,
+            "last_tok": state["last_tok"].at[slot].set(first[0]),
+            "keys": state["keys"].at[slot].set(key),
+            "remaining": state["remaining"].at[slot].set(max_new - 1),
+        }
+        if self._spec:
+            new_state.update(_seed_history(
+                state, tokens, p_len, slot, first[0]
+            ))
+        if self._adapters:
+            new_state["adapter_ids"] = state["adapter_ids"].at[slot].set(
+                jnp.asarray(aid, jnp.int32)
+            )
+        return new_state, first[0]
+
+    def _splice_paged_fn(self, params, state, row, suffix, full, depth,
+                         p_len, slot, seed, max_new, cow_src, cow_dst,
+                         aid=0):
+        """Paged prefix-cache-hit refill: O(suffix) HBM instead of the
+        classic segment copy. The donor's FULL shared pages (indices
+        ``< depth // page_size`` in ``row``) are referenced in place —
+        never copied, never written (all new writes land at positions
+        ``>= depth``, i.e. page index ``>= depth // page_size``). A
+        partially-shared boundary page is copy-on-written: ``cow_src``
+        (the donor's page) is gathered and scattered whole into
+        ``cow_dst`` (a fresh page already at ``row[depth//page_size]``);
+        positions beyond ``depth`` in the copy are the donor's stale
+        tail, overwritten by this suffix prefill's stores (which precede
+        attention reads) or masked by the validity row — the exact
+        stale-tail argument the classic splice rests on. When ``depth``
+        is page-aligned both ids arrive as the sentinel (pool_pages) and
+        the gather/scatter no-op via fill/drop, so ONE compiled shape
+        serves both cases.
+
+        The suffix forward runs through ``self._dec_model`` over a
+        batch-1 VIEW of the live pool: page_table = ``row``, cache_index
+        = ``depth``, pool leaves shared — suffix K/V streams DIRECTLY
+        into the slot's pages through the table. The merge-back installs
+        ``row``/``p_len`` at ``slot`` and keeps the updated pool;
+        everything else follows :meth:`_finish_prefill`. All page
+        geometry is traced DATA (no static argnames): compiles stay one
+        per suffix bucket."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
+        ax = 1 if self._scan_layers else 0
+        src = jnp.asarray([cow_src], jnp.int32)
+        dst = jnp.asarray([cow_dst], jnp.int32)
+
+        def cow(path, leaf):
+            name = _leaf_name(path)
+            if name not in _POOL_TO_FLAT:
+                return leaf
+            page = jnp.take(leaf, src, axis=ax, mode="fill", fill_value=0)
+            if self._scan_layers:
+                return leaf.at[:, dst].set(page, mode="drop")
+            return leaf.at[dst].set(page, mode="drop")
+
+        cache = jax.tree_util.tree_map_with_path(cow, state["cache"])
+        p_cap = self._pages_per_slot
+
+        def view(path, leaf):
+            name = _leaf_name(path)
+            if name == "page_table":
+                return jnp.broadcast_to(
+                    row, leaf.shape[:-2] + (1, p_cap)
+                ).astype(jnp.int32)
+            if name == "cache_index":
+                return jnp.full(leaf.shape[:-1] + (1,), depth, jnp.int32)
+            return leaf
+
+        cache1 = jax.tree_util.tree_map_with_path(view, cache)
+        logits, upd = self._dec_model.apply(
+            {"params": params, "cache": cache1}, suffix, decode=True,
+            mutable=["cache"], last_pos=p_len - 1 - depth, **kw,
+        )
+        key = jax.random.PRNGKey(seed)
+        first, key = sample_logits(
+            logits[:, -1].astype(jnp.float32), key,
+            self._temperature, self._top_k, self._top_p,
+        )
+
+        def merge(path, big, new1):
+            name = _leaf_name(path)
+            if name == "page_table":
+                return big.at[..., slot, :].set(
+                    jnp.asarray(row, big.dtype)
+                )
+            if name == "cache_index":
+                # the view's counter advanced by the suffix bucket; the
+                # slot's true position is p_len, same as classic splice
+                return big.at[..., slot].set(
+                    jnp.asarray(p_len, big.dtype)
+                )
+            return new1  # pool leaf: the updated pool IS the new pool
+
+        cache = jax.tree_util.tree_map_with_path(
+            merge, cache, upd["cache"]
+        )
+        new_state = {
+            "cache": cache,
+            "last_tok": state["last_tok"].at[slot].set(first[0]),
+            "keys": state["keys"].at[slot].set(key),
+            "remaining": state["remaining"].at[slot].set(max_new - 1),
+        }
+        if self._spec:
+            new_state.update(_seed_history(
+                state, full, p_len, slot, first[0]
+            ))
+        if self._adapters:
+            new_state["adapter_ids"] = state["adapter_ids"].at[slot].set(
+                jnp.asarray(aid, jnp.int32)
+            )
+        return new_state, first[0]
+
+    def _paged_park_fn(self, state, slot):
+        """Sentinel ``slot``'s page-table row and zero its budget. Paged
+        engines park on EVERY completion (classic ones only when budget
+        remains): an inactive slot still K/V-writes at advancing
+        positions each chain step, and through a live table those writes
+        would land in pages the host has already freed — or handed to a
+        prefix segment. Sentinel ids turn them into ``mode="drop"``
+        no-ops for every chain dispatched after this program; writes
+        from chains already in flight (pipelining) are sanitized by the
+        next allocation's full-row prefill scatter, which the device
+        runs after them in program order."""
+        def upd(path, leaf):
+            name = _leaf_name(path)
+            if name == "page_table":
+                return leaf.at[..., slot, :].set(self._pool_pages)
+            return leaf
+
+        new_state = dict(state)
+        new_state["cache"] = jax.tree_util.tree_map_with_path(
+            upd, state["cache"]
+        )
+        new_state["remaining"] = state["remaining"].at[slot].set(0)
+        return new_state
+
+    def _chunk_seed_paged_fn(self, cache, row, depth):
+        """Paged seed for a chunked-prefill prefix hit: gather-COPY the
+        donor's pages (``row``: ``ceil(depth/page_size)`` real ids,
+        sentinel-padded to the fixed table length) out of the live pool
+        into the UNPAGED batch-1 side cache the chunk steps accumulate
+        through — the paged analogue of :func:`.slots.seed_cache`.
+        Chunked prompts then prefill into all-fresh pages at the final
+        scatter (sharing is lost for them; the copy here is what buys
+        the reused-prefix FLOPs back). A partially-covered boundary page
+        copies whole — its tail past ``depth`` is donor-stale, dead
+        under the continuation's stores-then-reads order, the same
+        argument as the paged splice. Sentinel rows gather as zeros,
+        matching the zero-init the classic side cache starts from."""
+        ax = 1 if self._scan_layers else 0
+        flat = {
+            tuple(
+                str(getattr(k, "key", getattr(k, "idx", k)))
+                for k in path
+            ): leaf
+            for path, leaf in
+            jax.tree_util.tree_leaves_with_path(cache)
+        }
+        flat_to_pool = {v: k for k, v in _POOL_TO_FLAT.items()}
+
+        def build(path, proto):
+            name = _leaf_name(path)
+            if name == "cache_index":
+                return jnp.full(proto.shape, depth, jnp.int32)
+            pkey = tuple(
+                str(getattr(k, "key", getattr(k, "idx", k)))
+                for k in path
+            )[:-1] + (flat_to_pool[name],)
+            g = jnp.take(
+                flat[pkey], row, axis=ax, mode="fill", fill_value=0
+            )
+            if self._scan_layers:
+                out = g.reshape((g.shape[0], 1, -1) + g.shape[3:])
+            else:
+                out = g.reshape((1, -1) + g.shape[2:])
+            return out.astype(proto.dtype)
+
+        return jax.tree_util.tree_map_with_path(build, self._proto1)
+
+    def _chunk_final_paged_fn(self, params, cache1, state, suffix, full,
+                              last_local, p_len, slot, seed, max_new,
+                              row, aid=0):
+        """Paged final chunk: the same decode continuation as
+        :meth:`_chunk_final_fn` over the accumulated side cache, then
+        :func:`.slots.write_slot_paged` scatters the whole window into
+        the slot's fresh pages (``row``) — full-row, so it sanitizes
+        recycled pages exactly like the paged prefill does. No segment
+        rides out (paged retention pins page ids host-side)."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
+        logits, upd = self.model.apply(
+            {"params": params, "cache": cache1}, suffix, decode=True,
+            mutable=["cache"], last_pos=last_local, **kw,
+        )
+        key = jax.random.PRNGKey(seed)
+        first, key = sample_logits(
+            logits[:, -1].astype(jnp.float32), key,
+            self._temperature, self._top_k, self._top_p,
+        )
+        cache = write_slot_paged(
+            state["cache"], upd["cache"], row, slot, p_len,
+            self._page_size, self._scan_layers,
+        )
+        new_state = {
+            "cache": cache,
+            "last_tok": state["last_tok"].at[slot].set(first[0]),
+            "keys": state["keys"].at[slot].set(key),
+            "remaining": state["remaining"].at[slot].set(max_new - 1),
+        }
+        if self._spec:
+            new_state.update(_seed_history(
+                state, full, p_len, slot, first[0]
+            ))
+        if self._adapters:
+            new_state["adapter_ids"] = state["adapter_ids"].at[slot].set(
+                jnp.asarray(aid, jnp.int32)
+            )
+        return new_state, first[0]
+
     def _chain_fn(self, params, state):
         """``tokens_per_launch`` decode steps as one ``lax.scan`` — one
         launch, one (S, T) token block out. Every slot steps every time
@@ -672,7 +1054,9 @@ class ServeEngine:
         def step(carry, x):
             cache, tok, keys, remaining = carry
             active = remaining > 0
-            logits, upd = self.model.apply(
+            # _dec_model IS self.model unless paged (then it's the
+            # pool+page-table twin) — unpaged chains trace byte-identical
+            logits, upd = self._dec_model.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 decode=True, mutable=["cache"], **kw,
             )
@@ -770,7 +1154,7 @@ class ServeEngine:
             active = remaining > 0
             draft = ngram_draft(hist, hist_len, k, self._spec_ngram)
             toks_in = jnp.concatenate([tok[:, None], draft], axis=1)
-            logits, upd = self.model.apply(
+            logits, upd = self._dec_model.apply(
                 {"params": params, "cache": cache}, toks_in,
                 decode=True, mutable=["cache"], **kw,
             )
@@ -860,6 +1244,29 @@ class ServeEngine:
         if self._adapters:
             self._bank.check_id(aid)
             request.adapter_gen = self._bank.generation(aid)
+        if self._paged:
+            # paged admission (ISSUE 13): a request whose prompt+budget
+            # needs more pages than the whole pool holds can NEVER be
+            # scheduled — synchronous backpressure, same contract as
+            # QueueFull. (Transient pressure is different: a request
+            # that fits the pool but not the current free list just
+            # stays queued — _pop_request skips it until pages free.)
+            need = self._pool.pages_needed(
+                len(request.prompt) + request.max_new_tokens
+            )
+            if need > self._pool.pool_pages:
+                self._pool.shed()
+                if self._flight is not None:
+                    self._flight.record(
+                        "pool_shed", p_len=len(request.prompt),
+                        max_new=request.max_new_tokens, pages=need,
+                    )
+                raise PoolExhausted(
+                    f"request needs {need} pages but the pool holds "
+                    f"{self._pool.pool_pages} "
+                    f"({self._pool.page_size} tokens each) — shrink the "
+                    "request or grow the pool"
+                )
         rid = self.scheduler.submit(request)
         if self._flight is not None:
             # stamped AFTER admission: rejected submissions never open a
@@ -993,12 +1400,41 @@ class ServeEngine:
         """Queue pop, chunk-aware when chunked prefill is on: with a
         long prompt already mid-chunked-prefill, only requests that fit
         one chunk pop (they slip around the long one into free slots
-        instead of queueing a second multi-step prefill behind it)."""
-        if self._chunk:
-            return self.scheduler.pop(
-                chunk=self._chunk, pending_long=len(self._pending)
-            )
-        return self.scheduler.pop()
+        instead of queueing a second multi-step prefill behind it).
+
+        Paged engines (ISSUE 13) additionally pass a ``fits`` predicate
+        — enough FREE pages for the request's whole prompt + budget
+        (conservative: prefix sharing can only reduce the real need) — so
+        oversubscribed slot counts degrade to queueing, never to a
+        mid-decode allocation failure. When nothing fits but the queue
+        is non-empty, cold unpinned prefix segments are evicted one at a
+        time (each eviction returns pages to the pool) and the pop
+        retried; the loop is bounded by the segment count."""
+        fits = None
+        if self._paged:
+            pool = self._pool
+
+            def fits(r):
+                return pool.available >= pool.pages_needed(
+                    len(r.prompt) + r.max_new_tokens
+                )
+
+        while True:
+            if self._chunk:
+                req = self.scheduler.pop(
+                    chunk=self._chunk, pending_long=len(self._pending),
+                    fits=fits,
+                )
+            else:
+                req = self.scheduler.pop(fits=fits)
+            if req is not None or fits is None:
+                return req
+            if (
+                len(self.scheduler) == 0
+                or self.prefix is None
+                or not self.prefix.evict_coldest()
+            ):
+                return None
 
     def _deadline_for(self, req: Request) -> float | None:
         return (
@@ -1039,7 +1475,9 @@ class ServeEngine:
                         )
             if reason is not None:
                 self._slots[s] = None
-                if act.remaining > 0:
+                if self._paged:
+                    self._park_paged(s, act)
+                elif act.remaining > 0:
                     self._state["remaining"] = self._park(
                         self._state["remaining"], s
                     )
@@ -1171,6 +1609,10 @@ class ServeEngine:
             return self._begin_chunked(
                 slot, req, prompt, p_len, pkey, hit, grow, aid
             )
+        if self._paged:
+            return self._refill_paged(
+                slot, req, prompt, p_len, bucket, pkey, hit, grow, aid
+            )
         segment = None
         try:
             if self._chaos is not None:
@@ -1239,15 +1681,162 @@ class ServeEngine:
             hit[0] if segment is not None else 0,
         )
 
+    def _refill_paged(self, slot: int, req: Request, prompt: list[int],
+                      p_len: int, bucket: int, pkey: list[int], hit,
+                      grow: bool, aid: int) -> list[Completion]:
+        """Paged twin of :meth:`_refill`'s device leg. The host side owns
+        all page arithmetic — which donor pages are shared in place,
+        which one boundary page copy-on-writes, which fresh pages the
+        pool hands out — and ships it to the device as one traced row
+        vector plus a CoW id pair; the device programs never recompile
+        on geometry. ``_pop_request``'s ``fits`` predicate guaranteed
+        the fresh allocation below succeeds (conservatively — sharing
+        only reduces the need), so ``PoolExhausted`` here would be a
+        bookkeeping bug, caught by the same isolation path as a raising
+        prefill."""
+        pool = self._pool
+        ps = self._page_size
+        sentinel = self._pool_pages
+        n_alloc = pool.pages_needed(p_len + req.max_new_tokens)
+        segment = None
+        pages: list[int] = []
+        try:
+            if self._chaos is not None:
+                chaos_lib.maybe_fail_prefill(self._chaos, req.request_id)
+            akw = {"aid": aid} if self._adapters else {}
+            if hit is not None:
+                depth, segment = hit
+                # pin the donor FIRST, same contract as the classic path
+                self.prefix.acquire(segment)
+                shared_full = depth // ps
+                boundary = depth % ps != 0
+                # shared pages are refcounted BEFORE the fresh alloc so
+                # the except path below can release `pages` uniformly
+                for pid in segment.handle[:shared_full]:
+                    pool.retain(pid)
+                pages = list(segment.handle[:shared_full])
+                pages = pages + pool.alloc(n_alloc - shared_full)
+                # a partially-shared boundary page copy-on-writes into
+                # the first fresh page; page-aligned depth passes the
+                # sentinel pair (the compiled gather/scatter no-ops)
+                cow_src = (
+                    int(segment.handle[shared_full]) if boundary
+                    else sentinel
+                )
+                cow_dst = pages[shared_full] if boundary else sentinel
+                if boundary and self._flight is not None:
+                    self._flight.record(
+                        "page_cow", rid=req.request_id, slot=slot,
+                        src=cow_src, dst=cow_dst, depth=depth,
+                    )
+                row = jnp.asarray(
+                    pages + [sentinel] * (self._pages_per_slot - n_alloc),
+                    jnp.int32,
+                )
+                suffix = prompt[depth:]
+                s_bucket = bucket_len(len(suffix), self.window)
+                tokens = jnp.asarray(
+                    [suffix + [0] * (s_bucket - len(suffix))], jnp.int32
+                )
+                full = (
+                    jnp.asarray(
+                        [prompt + [0] * (bucket - p_len)], jnp.int32
+                    )
+                    if self._spec
+                    else tokens  # dead operand when speculation is off
+                )
+                self._state, first = self._splice_paged(
+                    self.params, self._state, row, tokens, full, depth,
+                    p_len, slot, req.seed, req.max_new_tokens,
+                    cow_src, cow_dst, **akw,
+                )
+                self.n_splices += 1
+                self.prefix_hit_tokens += depth
+            else:
+                pages = pool.alloc(n_alloc)
+                row = jnp.asarray(
+                    pages + [sentinel] * (self._pages_per_slot - n_alloc),
+                    jnp.int32,
+                )
+                padded = prompt + [0] * (bucket - p_len)
+                tokens = jnp.asarray([padded], jnp.int32)
+                self._state, first = self._prefill_paged(
+                    self.params, self._state, tokens, row, p_len, slot,
+                    req.seed, req.max_new_tokens, **akw,
+                )
+                self.n_prefills += 1
+            if grow:
+                self._insert_paged_segment(pkey, pages, p_len)
+            first = int(jax.device_get(first))
+        except Exception:
+            if segment is not None:
+                self.prefix.release(segment)
+            if pages:
+                pool.release_all(pages)
+            self.n_prefill_errors += 1
+            if self._flight is not None:
+                self._flight.fault(
+                    "prefill_error", rid=req.request_id, slot=slot
+                )
+            # paged park: sentinel the table too — the failed prefill
+            # may have scattered into pages just released above
+            self._state = self._paged_park(self._state, slot)
+            return [self._complete_unstarted(req, "error")]
+        return self._activate(
+            slot, req, first, segment,
+            hit[0] if segment is not None else 0, pages=pages,
+        )
+
+    def _insert_paged_segment(self, pkey: list[int], pages: list[int],
+                              p_len: int) -> None:
+        """Insert-on-prefill, paged flavor: the retained "segment" is the
+        tuple of page ids covering the prompt's positions — the pool
+        pages themselves are the storage, so retention costs ZERO extra
+        HBM (the classic path copies a whole bucket-length cache tree).
+        Page refs are taken FIRST; a refused insert (duplicate key /
+        budget full of pinned segments) releases them, so pool
+        accounting is exact either way. The index prices the segment at
+        page granularity (pages x page_bytes)."""
+        seg_ids = tuple(pages[: self._pool.pages_needed(p_len)])
+        for pid in seg_ids:
+            self._pool.retain(pid)
+        if not self.prefix.insert(
+            tuple(pkey), seg_ids, len(seg_ids) * self._page_bytes
+        ):
+            self._pool.release_all(seg_ids)
+
+    def _release_segment_pages(self, seg) -> None:
+        """Prefix-index eviction hook (paged engines): a dropped segment
+        returns its page references to the pool. Runs BEFORE the index
+        clears ``seg.handle``; eviction only ever happens at refill /
+        pop time, and pinned (refcount > 0) segments are never victims,
+        so no live slot is decoding through these pages when they
+        free."""
+        self._pool.release_all(seg.handle)
+
+    def _park_paged(self, slot: int, act: _Active | None = None) -> None:
+        """Host half of paged parking: dispatch the sentinel-table park
+        program and hand the slot's page references back to the pool.
+        Safe against in-flight chains by device program order — see
+        :meth:`_paged_park_fn`."""
+        self._state = self._paged_park(self._state, slot)
+        if act is not None and act.pages:
+            self._pool.release_all(act.pages)
+            act.pages = []
+
     def _activate(self, slot: int, req: Request, first: int, segment,
-                  cached_len: int) -> list[Completion]:
+                  cached_len: int, pages=None) -> list[Completion]:
         """Admit a just-prefilled request into the decode phase — the
         shared tail of :meth:`_refill` and a chunked prefill's final
         chunk. ``segment`` pins the splice donor until completion; an
         EOS / ``max_new == 1`` first token completes immediately and
-        parks the slot (its device-side counter still shows budget)."""
+        parks the slot (its device-side counter still shows budget).
+        ``pages`` (paged engines) transfers the slot's page references
+        onto the active record — released whenever the slot parks."""
         self.generated_tokens += 1
         act = _Active(req, first)
+        if pages:
+            act.pages = pages
         act.ttft_s = time.perf_counter() - req.submitted_s
         if self._flight is not None:
             # stamped after the scalar fetch: the first token exists, so
@@ -1261,7 +1850,9 @@ class ServeEngine:
             act.segment = segment
         if req.max_new_tokens == 1 or first == req.eos_token:
             reason = "eos" if first == req.eos_token else "length"
-            if act.remaining > 0:
+            if self._paged:
+                self._park_paged(slot, act)
+            elif act.remaining > 0:
                 # early EOS: the device-side counter still shows budget;
                 # park the slot so later chains treat it as inactive
                 self._state["remaining"] = self._park(
@@ -1289,18 +1880,42 @@ class ServeEngine:
         try:
             if self._chaos is not None:
                 chaos_lib.maybe_fail_prefill(self._chaos, req.request_id)
+            if self._paged:
+                # all the slot's pages are FRESH for chunked prompts
+                # (the side cache re-prefills shared positions too, so
+                # the final scatter owns every page it writes — sharing
+                # is lost for chunked prompts, a documented trade)
+                pend.pages = self._pool.alloc(
+                    self._pool.pages_needed(p_len + req.max_new_tokens)
+                )
             if hit is not None:
                 depth, segment = hit
                 # pin the donor FIRST, same contract as _refill
                 self.prefix.acquire(segment)
                 pend.segment = segment
                 pend.depth = depth
-                pend.cache1 = self._chunk_seed(segment.handle, depth)
+                if self._paged:
+                    # gather-copy the donor's pages into the side cache
+                    n_seg = self._pool.pages_needed(depth)
+                    srow = jnp.asarray(
+                        list(segment.handle[:n_seg])
+                        + [self._pool_pages]
+                        * (self._pages_per_slot - n_seg),
+                        jnp.int32,
+                    )
+                    pend.cache1 = self._chunk_seed_paged(
+                        self._state["cache"], srow, depth
+                    )
+                else:
+                    pend.cache1 = self._chunk_seed(segment.handle, depth)
             else:
                 pend.cache1 = self._chunk_zero()
         except Exception:
             if pend.segment is not None:
                 self.prefix.release(pend.segment)
+            if pend.pages:
+                self._pool.release_all(pend.pages)
+                pend.pages = []
             self.n_prefill_errors += 1
             if self._flight is not None:
                 self._flight.fault(
@@ -1383,11 +1998,24 @@ class ServeEngine:
                 if self._spec
                 else tokens  # dead operand when speculation is off
             )
-            self._state, first, new_seg = self._chunk_final(
-                self.params, pend.cache1, self._state, tokens, full,
-                rem - 1, p_len, slot, req.seed, req.max_new_tokens,
-                seg_len=bucket, grow=pend.grow, **akw,
-            )
+            if self._paged:
+                row = jnp.asarray(
+                    pend.pages
+                    + [self._pool_pages]
+                    * (self._pages_per_slot - len(pend.pages)),
+                    jnp.int32,
+                )
+                self._state, first = self._chunk_final_paged(
+                    self.params, pend.cache1, self._state, tokens, full,
+                    rem - 1, p_len, slot, req.seed, req.max_new_tokens,
+                    row, **akw,
+                )
+            else:
+                self._state, first, new_seg = self._chunk_final(
+                    self.params, pend.cache1, self._state, tokens, full,
+                    rem - 1, p_len, slot, req.seed, req.max_new_tokens,
+                    seg_len=bucket, grow=pend.grow, **akw,
+                )
             self.n_chunks += 1
             if pend.segment is not None:
                 self.n_splices += 1
@@ -1395,12 +2023,17 @@ class ServeEngine:
             else:
                 self.n_prefills += 1
             if pend.grow:
-                self.prefix.insert(
-                    tuple(pend.pkey), new_seg, tree_nbytes(new_seg)
-                )
+                if self._paged:
+                    self._insert_paged_segment(
+                        pend.pkey, pend.pages, p_len
+                    )
+                else:
+                    self.prefix.insert(
+                        tuple(pend.pkey), new_seg, tree_nbytes(new_seg)
+                    )
             first = int(jax.device_get(first))
         except Exception:
-            self._abandon_pending(pend)
+            self._abandon_pending(pend)  # also releases pend.pages
             self.n_prefill_errors += 1
             if self._flight is not None:
                 self._flight.fault(
@@ -1408,23 +2041,35 @@ class ServeEngine:
                 )
             # defensive park, same as _refill: the final chunk may have
             # set the slot's device budget before raising
-            self._state["remaining"] = self._park(
-                self._state["remaining"], slot
-            )
+            if self._paged:
+                self._state = self._paged_park(self._state, slot)
+            else:
+                self._state["remaining"] = self._park(
+                    self._state["remaining"], slot
+                )
             return [self._complete_unstarted(req, "error")]
         segment = pend.segment
         cached_len = pend.depth
+        pages = pend.pages
+        pend.pages = []  # ownership moves to the active record
         del self._pending[slot]
-        return self._activate(slot, req, first, segment, cached_len)
+        return self._activate(
+            slot, req, first, segment, cached_len, pages=pages
+        )
 
     def _abandon_pending(self, pend: _PendingPrefill) -> None:
-        """Drop a pending chunked prefill: unpin its splice donor and
-        free the slot for the next refill. The side cache futures are
-        simply released (nothing was spliced into slot state, and the
-        slot's device budget was never set — no park needed)."""
+        """Drop a pending chunked prefill: unpin its splice donor,
+        return its pre-allocated pages (paged engines), and free the
+        slot for the next refill. The side cache futures are simply
+        released (nothing was spliced into slot state, and the slot's
+        device budget — and page table — were never set, so no park is
+        needed)."""
         if pend.segment is not None:
             self.prefix.release(pend.segment)
             pend.segment = None
+        if pend.pages:
+            self._pool.release_all(pend.pages)
+            pend.pages = []
         self._pending.pop(pend.slot, None)
 
     def _prefix_key(self, prompt: list[int], aid: int) -> list[int]:
@@ -1494,7 +2139,12 @@ class ServeEngine:
                 reason = "length"
             if reason is not None:
                 self._slots[s] = None
-                if act.remaining > 0:  # finished mid-chain (EOS/poison)
+                if self._paged:
+                    # paged parks on EVERY completion: an inactive slot
+                    # still K/V-writes at advancing positions, and a
+                    # live table would route them into freed pages
+                    self._park_paged(s, act)
+                elif act.remaining > 0:  # finished mid-chain (EOS/poison)
                     self._state["remaining"] = self._park(
                         self._state["remaining"], s
                     )
@@ -1548,7 +2198,9 @@ class ServeEngine:
                 reason = "length"
             if reason is not None:
                 self._slots[s] = None
-                if act.remaining > 0:  # finished mid-chain via EOS
+                if self._paged:
+                    self._park_paged(s, act)
+                elif act.remaining > 0:  # finished mid-chain via EOS
                     self._state["remaining"] = self._park(
                         self._state["remaining"], s
                     )
@@ -1714,8 +2366,30 @@ class ServeEngine:
             "n_chunks": self.n_chunks,
         }
 
+    def page_stats(self) -> dict[str, int | float]:
+        """Paged-KV counters for the serving receipt (ISSUE 13): pool
+        geometry (config — regress.py fingerprints ``paged`` /
+        ``page_size`` / ``pool_pages``) plus occupancy outcomes
+        (``pages_*`` counters, excluded from the fingerprint).
+        ``hbm_high_water_bytes`` is the pool HBM high-water mark —
+        ``high_water`` pages priced at the per-page leaf footprint —
+        the number the oversubscription win is stated in. Host
+        bookkeeping only — no device fetch."""
+        if not self._paged:
+            return {"paged": 0}
+        return {
+            "paged": 1,
+            "page_size": self._page_size,
+            "pool_pages": self._pool_pages,
+            "page_bytes": self._page_bytes,
+            "hbm_high_water_bytes":
+                self._pool.high_water * self._page_bytes,
+            **{f"pages_{k}": v for k, v in self._pool.stats().items()},
+        }
+
     _STATS_PARTS = (
-        "prefix", "spec", "adapters", "fault", "flight", "pipeline"
+        "prefix", "spec", "adapters", "fault", "flight", "pipeline",
+        "pages",
     )
 
     def stats(self, *parts: str) -> dict[str, int | float]:
@@ -1740,6 +2414,7 @@ class ServeEngine:
             "fault": self.fault_stats,
             "flight": self.flight_stats,
             "pipeline": self.pipeline_stats,
+            "pages": self.page_stats,
         }
         out: dict[str, int | float] = {}
         for part in self._STATS_PARTS:

@@ -1,0 +1,163 @@
+"""Host-side page-pool allocator for the paged KV cache (ISSUE 13).
+
+This is the jax-free half of the paged-attention design (vLLM, SOSP '23)
+rebuilt for XLA's fixed-shape discipline: the device holds ONE
+``(pool_pages, page_size, ...)`` K/V pool per attention layer plus a
+per-slot int32 page-table vector riding slot state as DATA
+(models/transformer.py gathers pages by table entry with ``jnp.take``;
+page ids are never Python control flow). THIS module is the other half:
+a free-list allocator with per-page refcounts that admission, refill,
+and the radix prefix index (serve/prefix.py) drive from the host.
+
+Design rules (the engine's paged contracts lean on every one):
+
+- **Allocation only at refill, never mid-decode.** The engine
+  pre-allocates ``pages_needed(p_len + max_new_tokens)`` pages before a
+  request enters a slot, so a decode chain can never fail an
+  allocation. Transient exhaustion keeps the request QUEUED (the
+  scheduler's ``fits`` predicate); only a request that could never fit
+  the whole pool raises :class:`PoolExhausted` at submit —
+  backpressure is synchronous, like ``QueueFull``, never a mid-decode
+  failure.
+- **Refcounts implement prefix sharing.** A prefix-cache hit RETAINS
+  the donor segment's fully-shared pages (refcount + 1 per reader)
+  instead of copying the segment; the first divergent write goes to a
+  fresh copy-on-write page (the engine's splice does the one-page copy
+  on device). A page returns to the free list only when its last
+  holder releases it.
+- **Lowest-id-first reuse** (a heap, not a LIFO stack) keeps the pool's
+  occupied region dense, which makes the ``high_water`` counter an
+  honest HBM high-water mark: ``high_water * page_bytes`` is the most
+  pool memory that was ever live at once.
+
+Host-only by contract: importing this module must not touch jax
+(tests/test_prefix.py pins it in a subprocess alongside
+prefix/scheduler/registry/router/chaos).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, Iterable, List
+
+
+class PoolExhausted(Exception):
+    """Raised at ``ServeEngine.submit`` when a request needs more pages
+    than the whole pool holds — it could NEVER be scheduled, so the
+    caller gets synchronous backpressure (the ``QueueFull`` discipline).
+    Transient pressure never raises: requests wait queued until enough
+    pages free up."""
+
+
+class PagePool:
+    """Fixed pool of ``pool_pages`` KV pages of ``page_size`` tokens.
+
+    Pure host bookkeeping — the device-side pool arrays live in the
+    engine's slot state; this object only decides WHICH page ids a
+    request owns and when they return to the free list.
+    """
+
+    def __init__(self, pool_pages: int, page_size: int):
+        if pool_pages < 1:
+            raise ValueError("pool_pages must be >= 1")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.pool_pages = int(pool_pages)
+        self.page_size = int(page_size)
+        # lowest-first free list: heapq keeps reuse dense so high_water
+        # is an honest HBM high-water mark
+        self._free: List[int] = list(range(self.pool_pages))
+        heapq.heapify(self._free)
+        self._refs: List[int] = [0] * self.pool_pages
+        self.n_allocs = 0
+        self.n_frees = 0
+        self.n_shares = 0
+        self.n_sheds = 0
+        self.high_water = 0
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def available(self) -> int:
+        """Pages currently on the free list."""
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        """Pages with at least one live holder."""
+        return self.pool_pages - len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        """Pages covering ``n_tokens`` tokens (ceiling division)."""
+        if n_tokens < 0:
+            raise ValueError("n_tokens must be >= 0")
+        return -(-n_tokens // self.page_size)
+
+    # -- allocation --------------------------------------------------------
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` fresh pages (each at refcount 1), lowest ids first.
+
+        Raises :class:`PoolExhausted` when fewer than ``n`` are free —
+        the engine's admission predicate makes this unreachable in
+        normal operation (it checks ``available`` on the same host
+        thread before popping the request)."""
+        if n < 0:
+            raise ValueError("n must be >= 0")
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} pages, {len(self._free)} free "
+                f"(pool_pages={self.pool_pages})"
+            )
+        out = [heapq.heappop(self._free) for _ in range(n)]
+        for pid in out:
+            self._refs[pid] = 1
+        self.n_allocs += n
+        self.high_water = max(self.high_water, self.in_use)
+        return out
+
+    def retain(self, pid: int) -> None:
+        """Add a holder to a LIVE page (prefix sharing: a splice pins
+        the donor segment's fully-shared pages instead of copying)."""
+        if self._refs[pid] <= 0:
+            raise ValueError(f"retain of free page {pid}")
+        self._refs[pid] += 1
+        self.n_shares += 1
+
+    def release(self, pid: int) -> None:
+        """Drop one holder; the page returns to the free list at zero."""
+        if self._refs[pid] <= 0:
+            raise ValueError(f"release of free page {pid}")
+        self._refs[pid] -= 1
+        if self._refs[pid] == 0:
+            heapq.heappush(self._free, pid)
+            self.n_frees += 1
+
+    def release_all(self, pids: Iterable[int]) -> None:
+        for pid in pids:
+            self.release(pid)
+
+    def refcount(self, pid: int) -> int:
+        return self._refs[pid]
+
+    # -- accounting --------------------------------------------------------
+
+    def shed(self) -> None:
+        """Count one admission-time :class:`PoolExhausted` rejection."""
+        self.n_sheds += 1
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "allocs": self.n_allocs,
+            "frees": self.n_frees,
+            "shares": self.n_shares,
+            "sheds": self.n_sheds,
+            "in_use": self.in_use,
+            "high_water": self.high_water,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"PagePool(pages={self.pool_pages}, page_size={self.page_size}, "
+            f"in_use={self.in_use}, high_water={self.high_water})"
+        )

@@ -97,10 +97,15 @@ class PrefixIndex:
     serving receipt.
     """
 
-    def __init__(self, byte_budget: int):
+    def __init__(self, byte_budget: int, on_evict=None):
         if byte_budget < 1:
             raise ValueError("byte_budget must be >= 1")
         self.byte_budget = int(byte_budget)
+        # eviction hook, called with the Segment BEFORE its handle is
+        # cleared — the paged engine (ISSUE 13) uses it to release the
+        # segment's page refcounts back to the pool; the index itself
+        # stays jax-free and handle-agnostic
+        self._on_evict = on_evict
         self._root = _Node()
         # key -> Segment, in LRU order (front = coldest)
         self._lru: collections.OrderedDict[tuple[int, ...], Segment] = (
@@ -209,7 +214,23 @@ class PrefixIndex:
             self._evict(victim)
         return True
 
+    def evict_coldest(self) -> bool:
+        """Evict the coldest UNPINNED segment, if any; returns whether
+        one was evicted. The paged engine calls this under page-pool
+        pressure (a queued request needs pages and the pool is dry but
+        cold segments still hold refcounts) — repeated calls terminate
+        because every eviction removes a segment."""
+        victim = next(
+            (s for s in self._lru.values() if s.refcount == 0), None
+        )
+        if victim is None:
+            return False
+        self._evict(victim)
+        return True
+
     def _evict(self, seg: Segment) -> None:
+        if self._on_evict is not None:
+            self._on_evict(seg)
         del self._lru[seg.key]
         node = self._root
         node.count -= 1

@@ -170,7 +170,8 @@ class FifoScheduler:
         self._queue.append(request)
         return request.request_id
 
-    def pop(self, chunk: int = 0, pending_long: int = 0) -> Request | None:
+    def pop(self, chunk: int = 0, pending_long: int = 0,
+            fits=None) -> Request | None:
         """Next request in arrival order, or None when idle.
 
         Chunk-aware admission (ISSUE 11): with ``chunk`` set (the
@@ -181,12 +182,25 @@ class FifoScheduler:
         multi-step prefill behind it, and the long request keeps its
         arrival-order claim on the next free slot once the pending one
         lands. The defaults are the plain FIFO, byte-identical behavior
-        for non-chunked engines."""
+        for non-chunked engines.
+
+        ``fits`` (ISSUE 13) is an optional host predicate over a
+        :class:`Request` — the paged engine passes "enough free pages" —
+        applied on top of the chunk rule: a request that doesn't fit
+        stays QUEUED in arrival position (it will pop once pages free
+        up; never a failure). ``fits=None`` is byte-identical to the
+        predicate-free pop."""
         if not self._queue:
             return None
         if chunk and pending_long:
             for i, r in enumerate(self._queue):
-                if len(r.prompt) <= chunk:
+                if len(r.prompt) <= chunk and (fits is None or fits(r)):
+                    del self._queue[i]
+                    return r
+            return None
+        if fits is not None:
+            for i, r in enumerate(self._queue):
+                if fits(r):
                     del self._queue[i]
                     return r
             return None

@@ -58,7 +58,7 @@ def bucket_len(p_len: int, window: int, floor: int = 8) -> int:
 
 
 def init_slot_state(model, params, n_slots: int, history: int = 0,
-                    adapters: bool = False):
+                    adapters: bool = False, paged: int = 0):
     """Zero-initialized slot-state pytree for ``n_slots`` concurrent
     requests of ``model`` (a :class:`..models.transformer.TransformerLM`
     or anything sharing its cache contract).
@@ -94,6 +94,16 @@ def init_slot_state(model, params, n_slots: int, history: int = 0,
     per-row gather index of :func:`..adapters.bank.apply_lora`. Same
     off-state contract as speculation: adapters off keeps the state tree
     byte-identical.
+
+    ``paged`` (the pool's ``pool_pages``, 0 = off) builds the state for a
+    PAGED model (``TransformerConfig(kv_pages=..., kv_page_size=...)``):
+    the model's own schema already declares the shared pools, the
+    ``(n_slots, P)`` page tables, and per-row ``(n_slots,)`` position
+    counters — no widening needed — and every ``page_table`` leaf is
+    filled with the sentinel id ``paged`` (== ``kv_pages``, out of
+    range), so an unbacked slot's decode writes DROP instead of
+    corrupting pool pages (see ``models/transformer.py
+    _store_paged_kv``).
     """
     if n_slots < 1:
         raise ValueError("n_slots must be >= 1")
@@ -109,8 +119,13 @@ def init_slot_state(model, params, n_slots: int, history: int = 0,
 
     def build(path, leaf):
         if _leaf_name(path) == "cache_index":
+            if paged:
+                # the paged schema already declares (S,) / (L, S)
+                return jnp.zeros(leaf.shape, jnp.int32)
             # () -> (S,), or (L,) -> (L, S) under scan_layers
             return jnp.zeros(leaf.shape + (n_slots,), jnp.int32)
+        if _leaf_name(path) == "page_table":
+            return jnp.full(leaf.shape, paged, jnp.int32)
         return jnp.zeros(leaf.shape, leaf.dtype)
 
     state = {
@@ -156,6 +171,69 @@ def write_slot(cache, prefill_cache, slot, p_len, scan_layers: bool):
         )
 
     return jax.tree_util.tree_map_with_path(upd, cache, prefill_cache)
+
+
+# pool-leaf name -> the flat (unpaged) cache leaf it is filled from: the
+# engine prefills through the UNPAGED model (classic whole-window batch-1
+# cache), then write_slot_paged scatters that cache into the shared pools.
+_POOL_TO_FLAT = {
+    "paged_key": "cached_key",
+    "paged_value": "cached_value",
+    "paged_key_scale": "cached_key_scale",
+    "paged_value_scale": "cached_value_scale",
+}
+
+
+def _path_strs(path) -> tuple:
+    """tree_map_with_path key path as a tuple of plain strings."""
+    return tuple(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    )
+
+
+def write_slot_paged(cache, prefill_cache, row, slot, p_len,
+                     page_size: int, scan_layers: bool):
+    """Paged refill: scatter a batch-1 UNPAGED prefilled cache into the
+    page-pool ``cache`` at the page ids of ``row``, install ``row`` as
+    slot ``slot``'s page table, and reset its position to ``p_len`` —
+    the paged twin of :func:`write_slot`.
+
+    ``row`` is the slot's full ``(P,)`` int32 page-table vector: freshly
+    allocated ids for the pages the request backs, the sentinel
+    (``kv_pages``) beyond. The prefill cache is full-window (prefill
+    zero-inits ``(1, max_seq_len, ...)`` and writes ``[0, bucket)``), so
+    reshaping its sequence axis to ``(P, page_size)`` yields every
+    logical page; the ``mode="drop"`` scatter writes the allocated ones
+    WHOLE — which doubles as the pool's sanitizer: any junk a previous
+    holder's in-flight chain wrote into a recycled page is fully
+    overwritten before this slot's first read (the engine dispatches the
+    refill AFTER any chain still holding the old table — device program
+    order). Sentinel rows drop. ``slot``/``p_len``/``row`` may be traced
+    (they are, inside the engine's jitted paged prefill) — no recompile
+    per slot, per length, or per page assignment."""
+    flat = {
+        _path_strs(p): leaf
+        for p, leaf in jax.tree_util.tree_leaves_with_path(prefill_cache)
+    }
+
+    def upd(path, big):
+        name = _leaf_name(path)
+        if name == "page_table":
+            return big.at[..., slot, :].set(jnp.asarray(row, big.dtype))
+        if name == "cache_index":
+            return big.at[..., slot].set(jnp.asarray(p_len, big.dtype))
+        src = flat[_path_strs(path)[:-1] + (_POOL_TO_FLAT[name],)]
+        if scan_layers:
+            # (L, 1, W, ...) -> (L, P, page_size, ...)
+            pages = src.reshape(
+                (src.shape[0], -1, page_size) + src.shape[3:]
+            )
+            return big.at[:, row].set(pages.astype(big.dtype), mode="drop")
+        # (1, W, ...) -> (P, page_size, ...)
+        pages = src.reshape((-1, page_size) + src.shape[2:])
+        return big.at[row].set(pages.astype(big.dtype), mode="drop")
+
+    return jax.tree_util.tree_map_with_path(upd, cache)
 
 
 def extract_segment(cache, seg_len: int, scan_layers: bool):

@@ -90,6 +90,28 @@ def _health_annotations(events: list[dict]) -> dict[int, str]:
     return notes
 
 
+def _pool_annotations(events: list[dict]) -> dict[int, str]:
+    """Paged-KV pool pressure is rare and load-bearing on a timeline: a
+    ``pool_shed`` is backpressure the caller felt (submit rejected — the
+    request wanted more pages than the whole pool holds) and a
+    ``page_cow`` is a shared prefix page being split on its first
+    divergent write. Flag both inline, like the health transitions, so
+    they stand out of the per-chain traffic."""
+    notes: dict[int, str] = {}
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "pool_shed":
+            notes[id(ev)] = (
+                f" [pool exhausted: wanted {ev.get('pages', '?')} pages]"
+            )
+        elif kind == "page_cow":
+            notes[id(ev)] = (
+                f" [shared page {ev.get('src', '?')} split -> "
+                f"{ev.get('dst', '?')}]"
+            )
+    return notes
+
+
 def _fmt_span(span: dict) -> str:
     rid = span.get("rid", "?")
     # fleet dumps tag every span with its replica; local rids collide
@@ -136,6 +158,7 @@ def render(snap: dict, index: int, max_events: int) -> None:
     trigger = snap.get("trigger")
     notes = _chain_annotations(snap["events"])
     notes.update(_health_annotations(snap["events"]))
+    notes.update(_pool_annotations(snap["events"]))
     print(f"\nevents (last {min(max_events, len(snap['events']))}):")
     for ev in snap["events"][-max_events:]:
         print(_fmt_event(ev, trigger, notes.get(id(ev), "")))

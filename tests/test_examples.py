@@ -73,6 +73,32 @@ def test_int8_serving_long_context_flash(tmp_path):
 
 
 @pytest.mark.slow
+def test_int8_serving_server_paged(tmp_path):
+    """--server --paged threads the page-pool geometry end to end: the
+    request-stream arm completes on a paged engine and the receipt
+    carries the pool config plus the hbm_high_water_bytes claim."""
+    import json
+
+    json_path = str(tmp_path / "serving.json")
+    out = _run([
+        "examples/serve_llm_int8.py", "--preset", "toy",
+        "--prompt_len", "8", "--new_tokens", "4", "--batch", "2",
+        "--server", "--requests", "6", "--slots", "2",
+        "--paged", "--page-size", "8",
+        "--ckpt_dir", str(tmp_path / "ck"), "--json", json_path,
+    ])
+    assert out.returncode == 0, out.stderr[-2000:]
+    with open(json_path) as f:
+        receipt = json.load(f)
+    assert receipt["paged"] == 1 and receipt["page_size"] == 8
+    # --pool-pages 0 sizes the pool to the whole-slot footprint:
+    # 2 slots x 64-token window / 8-token pages
+    assert receipt["pool_pages"] == 16
+    assert receipt["hbm_high_water_bytes"] > 0
+    assert receipt["pages_in_use"] == 0  # drained clean
+
+
+@pytest.mark.slow
 def test_int8_serving_from_hf_checkpoint(tmp_path):
     """--hf_checkpoint serves a published-format (HF safetensors) Llama
     directory through the same quantize-on-load pipeline — the

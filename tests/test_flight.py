@@ -439,3 +439,30 @@ def test_flight_view_renders_fleet_dump(tmp_path):
     assert "replica=router" in out.stdout  # the router's own events
     # per-replica chain scoping: nothing reads as overlapped here
     assert "in flight" not in out.stdout
+
+
+def test_flight_view_annotates_pool_events(tmp_path):
+    """Paged-KV pool events render with their inline annotations: a
+    pool_shed shows the page demand that bounced, a page_cow shows the
+    shared page being split — both legible without knowing the schema."""
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    path = str(tmp_path / "flight.jsonl")
+    rec = FlightRecorder(capacity=32, dump_path=path)
+    rec.record("pool_shed", p_len=30, max_new=30, pages=8)
+    rec.record("page_cow", rid=4, slot=1, src=2, dst=5, depth=20)
+    rec.dump(reason="end_of_stream")
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "flight_view.py"), path],
+        capture_output=True, text=True, timeout=120, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "[pool exhausted: wanted 8 pages]" in out.stdout
+    assert "[shared page 2 split -> 5]" in out.stdout
+    # both kinds tally in the snapshot's event-counts header line
+    counts = [ln for ln in out.stdout.splitlines()
+              if ln.startswith("event counts:")]
+    assert counts and "page_cow: 1" in counts[0]
+    assert "pool_shed: 1" in counts[0]

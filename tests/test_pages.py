@@ -1,0 +1,108 @@
+"""serve/pages.py: the jax-free page-pool allocator, in isolation.
+
+Pure host code — page ids are plain ints, refcounts are a list; nothing
+here may touch jax (the subprocess pin rides in tests/test_prefix.py
+alongside the scheduler/prefix/router pins). The engine-facing contract:
+``alloc`` raises :class:`PoolExhausted` synchronously instead of ever
+letting a request start decoding without pages, ``retain``/``release``
+implement the prefix-sharing refcounts (a page is freed only when its
+LAST holder releases), and the counters (``high_water`` in particular)
+feed the ``hbm_high_water_bytes`` receipt field.
+"""
+
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.serve.pages import PagePool, PoolExhausted
+
+
+def test_alloc_free_roundtrip():
+    pool = PagePool(pool_pages=4, page_size=8)
+    pages = pool.alloc(3)
+    assert len(pages) == len(set(pages)) == 3
+    assert pool.in_use == 3 and pool.available == 1
+    assert all(pool.refcount(p) == 1 for p in pages)
+    pool.release_all(pages)
+    assert pool.in_use == 0 and pool.available == 4
+    assert pool.stats()["allocs"] == 3 and pool.stats()["frees"] == 3
+
+
+def test_alloc_exhaustion_raises_and_leaves_pool_unchanged():
+    pool = PagePool(pool_pages=4, page_size=8)
+    held = pool.alloc(3)
+    with pytest.raises(PoolExhausted):
+        pool.alloc(2)  # only 1 free
+    # a failed alloc must not leak or consume anything
+    assert pool.available == 1 and pool.in_use == 3
+    pool.alloc(1)  # the remaining page still allocates
+    pool.release_all(held)
+
+
+def test_pages_needed_is_ceil_division():
+    pool = PagePool(pool_pages=8, page_size=8)
+    assert pool.pages_needed(1) == 1
+    assert pool.pages_needed(8) == 1
+    assert pool.pages_needed(9) == 2
+    assert pool.pages_needed(64) == 8
+
+
+def test_refcount_sharing_frees_on_last_release():
+    """The prefix-hit lifecycle: a retained page survives its first
+    holder's release and frees only when the segment lets go too."""
+    pool = PagePool(pool_pages=2, page_size=8)
+    (pid,) = pool.alloc(1)
+    pool.retain(pid)  # the prefix segment pins it
+    assert pool.refcount(pid) == 2
+    assert pool.stats()["shares"] == 1
+    pool.release(pid)  # the decoding slot completes
+    assert pool.refcount(pid) == 1
+    assert pool.in_use == 1  # still held by the segment
+    pool.release(pid)  # segment evicted
+    assert pool.in_use == 0 and pool.available == 2
+
+
+def test_retain_and_release_of_free_page_raise():
+    pool = PagePool(pool_pages=2, page_size=8)
+    with pytest.raises(ValueError):
+        pool.retain(0)  # never allocated
+    with pytest.raises(ValueError):
+        pool.release(1)
+    (pid,) = pool.alloc(1)
+    pool.release(pid)
+    with pytest.raises(ValueError):
+        pool.release(pid)  # double free
+
+
+def test_high_water_tracks_peak_and_ids_stay_low():
+    """high_water is the honest HBM claim: the allocator hands out the
+    LOWEST free ids first, so peak-id-based accounting never inflates
+    past the true concurrent maximum."""
+    pool = PagePool(pool_pages=8, page_size=8)
+    a = pool.alloc(3)
+    pool.release_all(a)
+    b = pool.alloc(2)
+    # reuses the freed low ids rather than marching up the pool
+    assert max(b) <= 2
+    assert pool.high_water == 3
+    assert pool.stats()["high_water"] == 3
+    pool.release_all(b)
+
+
+def test_shed_counter():
+    pool = PagePool(pool_pages=2, page_size=8)
+    assert pool.stats()["sheds"] == 0
+    pool.shed()
+    assert pool.stats()["sheds"] == 1
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        PagePool(pool_pages=0, page_size=8)
+    with pytest.raises(ValueError):
+        PagePool(pool_pages=4, page_size=0)
+
+
+def test_alloc_validation():
+    pool = PagePool(pool_pages=4, page_size=8)
+    assert pool.alloc(0) == []  # zero-page alloc is a no-op, not an error
+    with pytest.raises(ValueError):
+        pool.alloc(-1)

@@ -131,6 +131,39 @@ def test_lru_evicts_coldest_first():
     assert [s.handle for s in idx.segments()] == ["a", "c"]
 
 
+def test_evict_coldest_respects_pins_and_reports(
+):
+    """The paged engine's pool-pressure valve (ISSUE 13): evict_coldest
+    frees exactly one UNPINNED segment per call (so repeated calls
+    terminate), skips pinned ones, and reports False when nothing is
+    evictable."""
+    idx = _idx(budget=100)
+    idx.insert((1,), "a", 30)
+    idx.insert((2,), "b", 30)
+    _, pinned = idx.lookup((1, 9))
+    idx.acquire(pinned)  # (1,) is in use by a decoding slot
+    assert idx.evict_coldest() is True  # takes (2,), the coldest unpinned
+    assert (1,) in idx and (2,) not in idx
+    assert idx.evict_coldest() is False  # only the pinned one remains
+    idx.release(pinned)
+    assert idx.evict_coldest() is True
+    assert idx.evict_coldest() is False  # empty index
+
+
+def test_on_evict_hook_fires_with_live_handle():
+    """The hook is how the paged engine returns a segment's page
+    refcounts to the pool: it must see the segment BEFORE the handle is
+    cleared, on every eviction path (LRU pressure and evict_coldest)."""
+    seen = []
+    idx = PrefixIndex(100, on_evict=lambda seg: seen.append(
+        (seg.key, seg.handle)
+    ))
+    idx.insert((1,), "a", 60)
+    idx.insert((2,), "b", 60)  # LRU-evicts (1,)
+    idx.evict_coldest()  # explicit path takes (2,)
+    assert seen == [((1,), "a"), ((2,), "b")]  # handles still live
+
+
 def test_oversized_insert_refused_without_collateral_eviction():
     idx = _idx(budget=100)
     idx.insert((1,), "a", 40)
@@ -205,6 +238,9 @@ def test_prefix_module_imports_no_jax():
         # host routing decisions — same contract as the scheduler
         "import pytorch_distributed_training_tutorials_tpu.serve.router\n"
         "import pytorch_distributed_training_tutorials_tpu.utils.chaos\n"
+        # the page-pool allocator (ISSUE 13) is host bookkeeping over
+        # page ids — refcounts and free lists never touch the device
+        "import pytorch_distributed_training_tutorials_tpu.serve.pages\n"
         "assert 'jax' not in sys.modules, 'prefix index must not import jax'\n"
     )
     env = {k: v for k, v in os.environ.items() if k != "PYTHONSTARTUP"}

@@ -1,0 +1,90 @@
+"""scripts/receipt_session.py builds the deferred-receipt runbook.
+
+The script's job is sequencing, not measuring — so the CPU pin is that
+it builds exactly the ten documented recipes (CLAUDE.md's "receipt has
+NOT been taken yet" list) with one shared checkpoint dir and
+round-stamped output names, without importing jax or needing a chip.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "receipt_session",
+        os.path.join(REPO, "scripts", "receipt_session.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_plan_covers_all_ten_deferred_arms():
+    mod = _load()
+    plan = mod.build_session(6, "/ckpt", "/out")
+    names = [n for n, _ in plan]
+    assert names == list(mod.ARM_NAMES) and len(names) == 10
+
+    cmds = dict(plan)
+    # every serving arm shares the ONE checkpoint cache and is a
+    # --server run; the base arm comes first so it pays the cold load
+    serve_arms = [n for n in names if n != "fused_mfu"]
+    assert serve_arms[0] == "base"
+    for n in serve_arms:
+        cmd = cmds[n]
+        assert "--server" in cmd and "--preset" in cmd
+        assert cmd[cmd.index("--ckpt_dir") + 1] == "/ckpt"
+        assert cmd[cmd.index("--json") + 1] == (
+            f"/out/SERVING_r06_{n}.json"
+        )
+    # each arm carries its documented flag delta
+    assert "--fused" in cmds["fused_mfu"]
+    assert "lm_headline" in " ".join(cmds["fused_mfu"])
+    assert cmds["fused_mfu"][-1] == "/out/TRAIN_LLM_r06_fused.json"
+    assert cmds["prefix"][cmds["prefix"].index("--prefix-overlap") + 1] \
+        == "0.7"
+    assert cmds["spec"][cmds["spec"].index("--spec-k") + 1] == "4"
+    assert "--adapters" in cmds["adapters"] \
+        and "--lora-rank" in cmds["adapters"]
+    assert cmds["deadline"][cmds["deadline"].index("--deadline-s") + 1] \
+        == "2"
+    assert cmds["flight"][cmds["flight"].index("--flight-log") + 1] \
+        == "/out/FLIGHT_r06.jsonl"
+    assert "--pipeline-depth" in cmds["pipeline"] \
+        and "--prefill-chunk" in cmds["pipeline"]
+    assert "--replicas" in cmds["fleet"] and "--qps" in cmds["fleet"]
+    # the paged arm is the long-window recipe: slot count decoupled
+    # from a 4096-token window
+    assert "--paged" in cmds["paged"]
+    assert cmds["paged"][cmds["paged"].index("--max_seq_len") + 1] \
+        == "4096"
+
+
+def test_only_filter_and_unknown_arm():
+    mod = _load()
+    plan = mod.build_session(7, "/ckpt", ".")
+    assert {n for n, _ in plan} == set(mod.ARM_NAMES)
+    with pytest.raises(SystemExit):
+        mod.main(["--round", "7", "--dry-run", "--only", "nonesuch"])
+
+
+def test_dry_run_subprocess_prints_plan_without_running():
+    out = subprocess.run(
+        [sys.executable, "scripts/receipt_session.py",
+         "--round", "99", "--dry-run", "--out-dir", "receipts"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("[")]
+    assert len(lines) == 10
+    assert any("SERVING_r99_paged.json" in ln for ln in lines)
+    assert any("TRAIN_LLM_r99_fused.json" in ln for ln in lines)
+    # dry run must not have created anything
+    assert not os.path.exists(os.path.join(REPO, "receipts"))

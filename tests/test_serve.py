@@ -2217,3 +2217,257 @@ def test_serve_selftest_router_subprocess(tmp_path):
     assert receipt["router_requests"] >= 3
     assert receipt["router_host_fetches_chaos"] >= 1
     assert load_receipt(json_path)["ok"] is True
+
+
+# ---------------------------------------------- paged KV cache (ISSUE 13)
+
+def _paged_geometry(pool_pages=6, page_size=8):
+    """Oversubscribed by construction at the module CFG: 2 slots x
+    64-token windows = 128 claimable tokens over a 48-token pool."""
+    return dict(paged=True, page_size=page_size, pool_pages=pool_pages)
+
+
+def test_paged_token_exact_oversubscribed(model_params):
+    """The ISSUE 13 acceptance pin: a mixed short+long stream through a
+    paged engine whose pool is SMALLER than n_slots * window is
+    token-identical to the whole-slot engine and to one-shot
+    ``generate()`` — pages, tables, and queued-for-pages waits are
+    invisible in the outputs — and every page returns to the free list
+    when the stream drains."""
+    model, params = model_params
+    reqs = [(_prompt(900 + i, p), m) for i, (p, m) in enumerate(
+        [(3, 9), (17, 12), (5, 5), (12, 6), (2, 17), (9, 14)]
+    )]
+    eng_ws, out_ws = _run_stream(model, params, reqs)
+    eng_pg, out_pg = _run_stream(model, params, reqs, **_paged_geometry())
+    assert [c.tokens for c in out_pg] == [c.tokens for c in out_ws]
+    for (p, m), c in zip(reqs, out_pg):
+        assert c.tokens == _reference(model, params, p, m)
+        assert c.finish_reason == "length"
+    st = eng_pg.page_stats()
+    assert st["paged"] == 1 and st["pages_in_use"] == 0
+    assert 1 <= st["pages_high_water"] <= 6
+    assert st["pages_allocs"] == st["pages_frees"]
+
+
+def test_paged_admission_shed_and_validation(model_params):
+    """A request that could never fit the pool sheds synchronously at
+    submit (PoolExhausted, the QueueFull discipline — never a mid-decode
+    failure); geometry errors are synchronous ValueErrors."""
+    from pytorch_distributed_training_tutorials_tpu.serve import PoolExhausted
+
+    model, params = model_params
+    engine = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=8, **_paged_geometry()
+    )
+    # 30 + 30 = 60 tokens -> 8 pages > the 6-page pool; note the
+    # 64-token WINDOW would admit it — the pool is the binding check
+    with pytest.raises(PoolExhausted):
+        engine.submit(Request(prompt=_prompt(1, 30), max_new_tokens=30))
+    assert engine.page_stats()["pages_sheds"] == 1
+    # 24 + 24 = 48 tokens = exactly the pool: admitted
+    rid = engine.submit(Request(prompt=_prompt(2, 24), max_new_tokens=24))
+    out = {c.request_id: c for c in engine.run_until_idle()}
+    assert out[rid].finish_reason == "length"
+    with pytest.raises(ValueError):  # geometry without paged=True
+        ServeEngine(model, params, n_slots=2, page_size=8)
+    with pytest.raises(ValueError):  # paged without geometry
+        ServeEngine(model, params, n_slots=2, paged=True)
+    with pytest.raises(ValueError):  # window 64 not divisible
+        ServeEngine(model, params, n_slots=2, paged=True, page_size=24,
+                    pool_pages=4)
+
+
+def test_paged_fetch_budget(model_params):
+    """Paged engines keep the budget EXACTLY chains + prefills +
+    splices: page-table updates ride the existing launches, the pool is
+    host bookkeeping, and a prefix splice still costs its one scalar
+    fetch."""
+    model, params = model_params
+    reqs = _overlap_stream(0.7, n_requests=6)
+    calls = {"n": 0}
+    real_get = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real_get(x)
+
+    jax.device_get = counting
+    try:
+        engine, out = _run_stream(
+            model, params, reqs, prefix_cache_bytes=16 * 1024 * 1024,
+            **_paged_geometry(pool_pages=16),
+        )
+    finally:
+        jax.device_get = real_get
+    assert len(out) == len(reqs)
+    assert calls["n"] == (
+        engine.n_chains + engine.n_prefills + engine.n_splices
+    )
+    assert engine.n_splices >= 1  # the prefix path actually exercised
+
+
+def test_paged_off_engine_unchanged(model_params):
+    """paged=False (the default) keeps the pre-paged engine bit for
+    bit: no pool/page-table leaves in the slot state, the decode model
+    IS the caller's model (so every chain jaxpr is unchanged), none of
+    the paged jit twins are even constructed, and page_stats() reports
+    the subsystem off."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    explicit = ServeEngine(model, params, n_slots=2, tokens_per_launch=8,
+                           paged=False)
+    assert engine.page_stats() == {"paged": 0}
+    assert engine._dec_model is model and explicit._dec_model is model
+    for eng in (engine, explicit):
+        leaf_names = {
+            str(getattr(p[-1], "key", p[-1]))
+            for p, _ in jax.tree_util.tree_flatten_with_path(
+                eng._state["cache"]
+            )[0]
+        }
+        assert "page_table" not in leaf_names
+        assert not any(n.startswith("paged_") for n in leaf_names)
+        assert not hasattr(eng, "_prefill_paged")
+        assert not hasattr(eng, "_splice_paged")
+    assert _tree_identical(engine._state, explicit._state)
+
+
+def test_paged_prefix_shares_and_cow(model_params):
+    """Prefix hits on a paged engine RETAIN shared pages instead of
+    copying segments (pages_shares > 0), a hit whose depth straddles a
+    page boundary triggers exactly the copy-on-write path (stamped as
+    ``page_cow`` flight events), and the tokens stay byte-identical to
+    the paged cache-off engine."""
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import FlightRecorder
+
+    model, params = model_params
+    # lengths 10/14 at 0.7 overlap give hit depths 7 and 9 — neither a
+    # multiple of page_size 8, so the boundary-page CoW must fire
+    reqs = _overlap_stream(0.7, n_requests=8)
+    eng_off, out_off = _run_stream(model, params, reqs,
+                                   **_paged_geometry(pool_pages=16))
+    rec = FlightRecorder(capacity=512)
+    eng_on, out_on = _run_stream(
+        model, params, reqs, prefix_cache_bytes=16 * 1024 * 1024,
+        flight=rec, **_paged_geometry(pool_pages=16),
+    )
+    assert [c.tokens for c in out_on] == [c.tokens for c in out_off]
+    assert eng_on.n_splices >= 1
+    st = eng_on.page_stats()
+    assert st["pages_shares"] >= 1
+    assert rec.kind_counts["page_cow"] >= 1
+    # retained segments hold pages after the drain; evicting them
+    # through the index returns every page to the pool (the on_evict
+    # hook wiring)
+    while eng_on.prefix.evict_coldest():
+        pass
+    assert eng_on.page_stats()["pages_in_use"] == 0
+
+
+def test_paged_pool_shed_flight_event(model_params):
+    """An admission-time shed is stamped as a host-only ``pool_shed``
+    flight event naming the request geometry — page pressure is visible
+    in the flight log without any device work."""
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import FlightRecorder
+    from pytorch_distributed_training_tutorials_tpu.serve import PoolExhausted
+
+    model, params = model_params
+    rec = FlightRecorder(capacity=64)
+    engine = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=8, flight=rec,
+        **_paged_geometry(),
+    )
+    with pytest.raises(PoolExhausted):
+        engine.submit(Request(prompt=_prompt(3, 30), max_new_tokens=30))
+    assert rec.kind_counts["pool_shed"] == 1
+    ev = [e for e in rec.events if e["kind"] == "pool_shed"]
+    assert ev and ev[0]["pages"] == 8 and ev[0]["p_len"] == 30
+
+
+@pytest.mark.parametrize(
+    "cfg_kwargs",
+    [
+        pytest.param(dict(scan_layers=True), marks=pytest.mark.slow),
+        pytest.param(dict(n_kv_heads=2), marks=pytest.mark.slow),
+        pytest.param(dict(kv_cache_dtype="int8"), marks=pytest.mark.slow),
+    ],
+    ids=["scan_layers", "gqa", "int8kv"],
+)
+def test_paged_token_exact_layouts(cfg_kwargs):
+    """The page-granular slot surgery generalizes across the scanned
+    (leading layer axis), GQA, and int8-KV cache layouts: paged output
+    stays engine-vs-engine token-exact on the oversubscribed stream."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, **cfg_kwargs)
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    reqs = [(_prompt(950 + i, p), m) for i, (p, m) in enumerate(
+        [(3, 9), (17, 12), (12, 6), (2, 17)]
+    )]
+    _, out_ws = _run_stream(model, params, reqs)
+    _, out_pg = _run_stream(model, params, reqs, **_paged_geometry())
+    assert [c.tokens for c in out_pg] == [c.tokens for c in out_ws]
+
+
+@pytest.mark.slow
+def test_paged_composed_spec_adapters_pipeline(model_params):
+    """The full composition: paged + prefix cache + speculation +
+    multi-tenant adapters + depth-2 pipelining with chunked prefill is
+    token-exact to the same composition on the whole-slot engine —
+    every subsystem reads the cache through the same paged path."""
+    model, params = model_params
+    bank = _lora_bank(model)
+    reqs = _overlap_stream(0.7, n_requests=8)
+    kw = dict(
+        prefix_cache_bytes=16 * 1024 * 1024, speculative_k=2,
+        adapter_bank=bank, pipeline_depth=2, prefill_chunk=8,
+    )
+
+    def run(**extra):
+        engine = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=8, **kw, **extra
+        )
+        ids = [
+            engine.submit(Request(prompt=p, max_new_tokens=m, seed=i,
+                                  adapter=(i % 3) % 2 + 1 if i % 3 else 0))
+            for i, (p, m) in enumerate(reqs)
+        ]
+        out = {c.request_id: c for c in engine.run_until_idle()}
+        return [out[r].tokens for r in ids]
+
+    assert run(**_paged_geometry(pool_pages=16)) == run()
+
+
+@pytest.mark.slow
+def test_serve_selftest_paged_subprocess(tmp_path):
+    """``--selftest --paged`` — the ISSUE 13 arm: an oversubscribed
+    mixed stream through a page-pool engine is token-identical to
+    whole-slot with the fetch budget intact, a pool-exceeding request
+    sheds at submit, and the prefix leg shows copy-free page sharing,
+    all counted into the receipt."""
+    from pytorch_distributed_training_tutorials_tpu.obs import load_receipt, validate_receipt
+
+    json_path = str(tmp_path / "selftest_paged.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_training_tutorials_tpu.serve", "--selftest",
+         "--paged", "--json", json_path],
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+        env=os.environ.copy(),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    receipt = json.loads(out.stdout.strip().splitlines()[-1])
+    assert receipt["ok"] is True, receipt.get("problems")
+    assert validate_receipt(receipt, kind="serve_selftest") == []
+    assert receipt["paged_token_exact"] is True
+    assert receipt["paged_prefix_token_exact"] is True
+    assert receipt["paged_shed_ok"] is True
+    assert receipt["paged"] == 1 and receipt["pool_pages"] == 6
+    assert receipt["pages_sheds"] == 1
+    assert receipt["paged_prefix_shares"] >= 1
+    assert receipt["pages_in_use"] == 0
+    assert receipt["hbm_high_water_bytes"] > 0
+    assert load_receipt(json_path)["ok"] is True

@@ -326,6 +326,40 @@ def test_traced_control_flow_catches_python_branch_on_finite_flag():
     assert not hits(check(clean), "traced-control-flow")
 
 
+def test_traced_control_flow_catches_python_branch_on_page_table():
+    """The paged-KV foot-gun (ISSUE 13): a slot's page-table entries are
+    DATA inside the compiled decode chain (they select which pool pages
+    the slot reads) — a Python branch on one would crash on the tracer
+    or compile per table content. The jnp.take gather twin (what
+    models/transformer.py's paged decode read actually does) must stay
+    silent."""
+    src = """
+        import jax
+
+        @jax.jit
+        def read_cache(pool, page_table, step):
+            if page_table[step] >= 0:   # the page id is data!
+                return pool[page_table[step]]
+            return pool[0]
+    """
+    found = hits(check(src), "traced-control-flow")
+    assert len(found) == 1 and found[0].line == 6
+
+    clean = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def read_cache(pool, page_table):
+            # gather pages by traced table entry; sentinel ids fall in
+            # mode="fill" zeros, masked by the validity row downstream
+            pages = jnp.take(pool, page_table, axis=0, mode="fill",
+                             fill_value=0)
+            return pages.reshape((-1,) + pool.shape[2:])
+    """
+    assert not hits(check(clean), "traced-control-flow")
+
+
 # -------------------------------------------------------------- host-sync-hazard
 
 def test_host_sync_fires_inside_jit():
