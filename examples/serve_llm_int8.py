@@ -635,6 +635,26 @@ def _reset_serving_counters(engine) -> None:
         engine.prefix.hits = engine.prefix.misses = 0
 
 
+def _serving_strategy(lm):
+    """TensorParallel strategy for the ``--server`` engines when the
+    model carries a TP mesh (ISSUE 15): the slot/KV state shards
+    head-wise with the int8 Megatron split the params already use, so
+    each chip holds 1/tp of the cache and the decode chain's only
+    collectives are the forward's existing all-reduces. None (the
+    replicated engine, byte-identical off-path) without a model axis."""
+    mesh = getattr(lm.cfg, "int8_mesh", None)
+    if mesh is None or mesh.shape.get("model", 1) <= 1:
+        return None
+    from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+        INT8_TP_RULES,
+    )
+    from pytorch_distributed_training_tutorials_tpu.parallel import (
+        TensorParallel,
+    )
+
+    return TensorParallel(mesh, INT8_TP_RULES)
+
+
 def _paged_kwargs(args, window: int) -> dict:
     """ServeEngine paged-geometry kwargs from the CLI flags. --pool-pages
     0 sizes the pool to the whole-slot footprint (slots * window worth of
@@ -732,10 +752,15 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
             pipeline_depth=args.pipeline_depth,
             prefill_chunk=args.prefill_chunk,
             flight=FlightRecorder(capacity=4096, t0=t0),
+            strategy=_serving_strategy(lm),
             **_paged_kwargs(args, window),
         )
         for _ in range(args.replicas)
     ]
+    if args.tp > 1:
+        # homogeneous fleet: one replica's compiled chain speaks for all
+        # (FleetRouter.stats passes the tp_* config keys through)
+        engines[0].audit_decode_hlo()
     router = FleetRouter(
         engines,
         hedge_after_s=args.hedge_after,
@@ -933,8 +958,18 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         flight=flight,
         pipeline_depth=args.pipeline_depth,
         prefill_chunk=args.prefill_chunk,
+        strategy=_serving_strategy(lm),
         **_paged_kwargs(args, window),
     )
+    if args.tp > 1:
+        # one extra AOT chain compile, once per receipt run: the
+        # zero-unexpected-collectives verdict (tp_hlo_ok) rides into
+        # the receipt via engine.stats()'s tp part
+        audit = engine.audit_decode_hlo()
+        print(
+            f"tp={args.tp} decode HLO audit: ok={audit['ok']} "
+            f"collectives={audit['collectives']}"
+        )
     rng = np.random.Generator(np.random.PCG64(11))
     # one shared token family: request i's prompt = shared[:k] + tail,
     # k = round(overlap * p_len) — every prompt of the stream shares its

@@ -121,6 +121,14 @@ CONFIG_FIELDS = (
     # pages_shares, pages_sheds, hbm_high_water_bytes) stay out
     # deliberately — outcomes of the traffic, not configuration
     "paged", "page_size", "pool_pages",
+    # sharded serving (ISSUE 15): "tp" above already fingerprints the
+    # TP width (the int8 decode receipts have carried it since r04);
+    # mesh_shape additionally separates mesh GEOMETRIES at equal tp
+    # (model:4 vs data:2,model:2 compile different collective schedules,
+    # so their tok/s are different experiments). The audit outcomes
+    # (tp_collectives, tp_hlo_ok) and the per-chip KV footprint stay
+    # out — outcomes, not configuration
+    "mesh_shape",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

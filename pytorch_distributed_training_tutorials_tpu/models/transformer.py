@@ -452,7 +452,19 @@ class Attention(nn.Module):
         decode is cache-traffic-bound — see the config field). int8
         storage additionally carries per-(batch, position, head) float32
         scales (absmax over head_dim — the same per-channel scheme
-        ops.quant uses for weights); scale vars are ``None`` otherwise."""
+        ops.quant uses for weights); scale vars are ``None`` otherwise.
+
+        The ``heads`` axis here is ALSO the tensor-parallel shard axis
+        for sharded serving (ISSUE 15): k/v_proj are column-parallel
+        (head-split) under TP_RULES/INT8_TP_RULES, so their activations
+        arrive head-sharded and the cache stores them without any
+        collective. The model body deliberately has NO
+        with_sharding_constraint — GSPMD propagates the layout from the
+        committed params + cache operands, and the serving engine pins
+        its cache trees at the jit boundaries
+        (``parallel.tensor_parallel.SLOT_STATE_RULES`` names these leaf
+        paths; ``ServeEngine._pin``). Renaming a cache variable here
+        breaks that rule table — keep them in sync."""
         cfg = self.cfg
         h, d = cfg.kv_heads, cfg.head_dim
         if cfg.kv_cache_dtype is not None:
@@ -493,7 +505,11 @@ class Attention(nn.Module):
         prefix-cache hits pin pages instead of copying segments. int8
         storage carries per-(page, offset, head) float32 scale pools —
         the same per-token-per-head absmax scheme as the unpaged cache
-        (``_quantize_kv``), just paged storage."""
+        (``_quantize_kv``), just paged storage. Under tensor-parallel
+        serving the pool leaves shard on the same ``kv_heads`` axis as
+        the flat cache (SLOT_STATE_RULES ``paged_*`` rules): page-table
+        gathers index the page axis, which stays replicated, so a
+        gather/scatter never crosses shards (ISSUE 15)."""
         cfg = self.cfg
         h, d = cfg.kv_heads, cfg.head_dim
         if cfg.kv_cache_dtype is not None:

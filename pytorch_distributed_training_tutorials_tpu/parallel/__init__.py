@@ -33,7 +33,9 @@ from pytorch_distributed_training_tutorials_tpu.parallel.pipeline_spmd import ( 
     spmd_pipeline,
 )
 from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import (  # noqa: F401
+    SLOT_STATE_RULES,
     TensorParallel,
+    audit_hlo,
 )
 from pytorch_distributed_training_tutorials_tpu.parallel.fsdp import (  # noqa: F401
     FSDP,

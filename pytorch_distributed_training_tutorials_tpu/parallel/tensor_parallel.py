@@ -28,6 +28,74 @@ from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (
 )
 from pytorch_distributed_training_tutorials_tpu.utils.tree import keystr as _path_str
 
+# Sharded serving (ISSUE 15): path rules for the ServeEngine slot/KV
+# state tree (the cache-leaf naming contract of models/transformer.py
+# ``Attention._cache_vars`` / ``_paged_cache_vars``). K/V and page-pool
+# leaves shard on the HEAD axis to match the Megatron attention split —
+# the decode-path q/k/v projections produce head-sharded activations, so
+# a head-sharded cache means the refill DUS, splice seeds, and paged
+# gathers all stay local to their shard (zero collectives beyond the
+# attention/FFN allreduces the forward already pays). Rules are written
+# against TRAILING dims (``_pad_spec`` left-pads), so ONE rule covers
+# both the unrolled ``(slots, W, heads, dim)`` and the nn.scan
+# ``(layers, slots, W, heads, dim)`` layouts — and the batch-1 prefill /
+# segment / side-cache trees, whose trailing dims are the same. Every
+# pattern is ``$``-anchored on the leaf name, so the bare K/V rules can
+# never swallow a ``_scale`` leaf regardless of rule order.
+# Everything else — page tables, position counters, last_tok, PRNG keys,
+# budgets, n-gram history, adapter ids — falls through to the replicated
+# default: per-slot bookkeeping is tiny and every shard needs it whole.
+# GQA degenerates safely: a kv_heads dim the model axis does not divide
+# drops to replicated via ``spec_for_path``'s shape check.
+SLOT_STATE_RULES = [
+    (r"cached_(key|value)_scale$", PartitionSpec(None, None, MODEL_AXIS)),
+    (r"cached_(key|value)$", PartitionSpec(None, None, MODEL_AXIS, None)),
+    (r"paged_(key|value)_scale$", PartitionSpec(None, None, MODEL_AXIS)),
+    (r"paged_(key|value)$", PartitionSpec(None, None, MODEL_AXIS, None)),
+]
+
+# KV leaf names whose REPLICATED resolution under tp > 1 deserves an
+# audit warning (mis-sharded cache = every decode step pays a reshard)
+_KV_LEAF_RE = re.compile(r"(cached|paged)_(key|value)(_scale)?$")
+
+# collective HLO ops. The serving decode audit whitelists all-reduce
+# only: the Megatron forward pays one allreduce per residual branch
+# (attention o_proj + FFN down_proj) plus the vocab-parallel logit
+# reduction, all of which compile to all-reduce; an all-gather /
+# reduce-scatter / all-to-all / collective-permute in a decode program
+# means a cache leaf or activation got resharded — the exact copy the
+# slot-state rules exist to prevent. ``-start`` catches async variants
+# once (their ``-done`` halves are deliberately unmatched).
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute)(?:-start)?\("
+)
+
+
+def audit_hlo(
+    hlo_text: str, whitelist: Sequence[str] = ("all-reduce",)
+) -> dict:
+    """Scan compiled HLO text for collective ops; return
+    ``{"collectives": {kind: count}, "problems": [lines], "ok": bool}``.
+    ``ok`` is False when any collective outside ``whitelist`` appears —
+    the "no unexpected collectives" receipt for sharded serving
+    (tests/test_tp_serve.py runs it over the compiled decode chain)."""
+    counts: dict[str, int] = {}
+    problems: list[str] = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group(1)
+        counts[kind] = counts.get(kind, 0) + 1
+        if kind not in whitelist:
+            problems.append(line.strip())
+    return {
+        "collectives": counts,
+        "problems": problems,
+        "ok": not problems,
+    }
+
 
 def _pad_spec(spec: PartitionSpec, ndim: int) -> PartitionSpec:
     """Left-pad a spec with None up to ``ndim`` (covers nn.scan's leading
@@ -145,9 +213,60 @@ class TensorParallel:
     def shard_batch(self, batch):
         return jax.device_put(batch, self.batch_sharding)
 
-    def audit(self, params) -> list[str]:
+    def _slot_spec(self, kp, leaf) -> PartitionSpec:
+        """Resolved slot-state spec for one leaf (SLOT_STATE_RULES +
+        mesh/shape filtering — GQA head dims the model axis does not
+        divide degenerate to replicated here)."""
+        return spec_for_path(
+            _path_str(kp), getattr(leaf, "ndim", 0), SLOT_STATE_RULES,
+            mesh=self.mesh,
+            shape=tuple(getattr(leaf, "shape", ()) or ()) or None,
+        )
+
+    def slot_shardings(self, state):
+        """NamedShardings for a ServeEngine slot-state (or any cache-
+        shaped) tree: K/V head-sharded per :data:`SLOT_STATE_RULES`,
+        bookkeeping replicated. Works on concrete arrays and
+        ``jax.eval_shape`` structs alike."""
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: NamedSharding(
+                self.mesh, self._slot_spec(kp, leaf)
+            ),
+            state,
+        )
+
+    def shard_slot_state(self, state):
+        """Place a freshly built slot-state tree per the slot rules —
+        committed sharded inputs are what make every engine jit compile
+        a GSPMD-sharded program instead of a replicated one."""
+        return jax.tree_util.tree_map(
+            jax.device_put, state, self.slot_shardings(state)
+        )
+
+    def constrain_slot_tree(self, tree):
+        """``with_sharding_constraint`` every leaf of a cache-shaped
+        tree per :data:`SLOT_STATE_RULES` — the trace-time pin the
+        engine applies after refill DUS, prefix splices, and paged
+        gathers/scatters so XLA keeps the head-sharded layout end to
+        end instead of inserting a reshard copy (specs resolve from the
+        traced leaves' own shapes, so slot caches, batch-1 segments,
+        and chunked side caches all pin through this one helper)."""
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, leaf: jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(self.mesh, self._slot_spec(kp, leaf))
+            ),
+            tree,
+        )
+
+    def audit(self, params, slot_state=None) -> list[str]:
         """Path -> spec lines for the placement audit (the 03-notebook
-        device/dtype audit twin)."""
+        device/dtype audit twin). With ``slot_state`` (ISSUE 15) the
+        audit ALSO walks a ServeEngine slot-state tree under
+        :data:`SLOT_STATE_RULES` and flags K/V leaves that resolved
+        replicated while the mesh has a real model axis — the
+        actionable mis-sharded-cache signal (every decode step would
+        pay a reshard copy; usual cause: a head dim the tp width does
+        not divide)."""
         lines = []
 
         def visit(kp, leaf):
@@ -159,4 +278,23 @@ class TensorParallel:
             lines.append(f"{path}: {tuple(leaf.shape)} -> {tuple(spec)}")
 
         jax.tree_util.tree_map_with_path(visit, params)
+        if slot_state is not None:
+            def visit_slot(kp, leaf):
+                path = _path_str(kp)
+                spec = self._slot_spec(kp, leaf)
+                line = f"{path}: {tuple(leaf.shape)} -> {tuple(spec)}"
+                if (
+                    self.tp_size > 1
+                    and _KV_LEAF_RE.search(path)
+                    and self.axis not in tuple(spec)
+                ):
+                    line += (
+                        f" WARNING: KV leaf replicated under tp="
+                        f"{self.tp_size} — each chip holds the whole "
+                        "cache and decode resharding copies it; check "
+                        f"that {self.axis!r} divides the head dim"
+                    )
+                lines.append(line)
+
+            jax.tree_util.tree_map_with_path(visit_slot, slot_state)
         return lines

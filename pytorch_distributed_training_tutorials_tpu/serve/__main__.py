@@ -72,8 +72,17 @@ never fit the pool must shed synchronously at submit
 with the prefix cache ON must show page SHARES (retained prefix pages
 seeding new requests copy-free) while staying byte-identical to the
 paged cache-off leg. ``page_stats()`` (occupancy high-water, shares,
-sheds) rides into the receipt. Prints exactly one JSON line (a
-``graft-receipt/v1`` envelope) and exits non-zero on any failure.
+sheds) rides into the receipt. A tenth (``--tp N``) arm replays the
+base staggered stream through a :class:`..parallel.TensorParallel`-
+sharded engine on a ``{'model': N}`` mesh (ISSUE 15): greedy tokens
+must stay byte-identical to the replicated engine, the fetch budget is
+unchanged (ONE batched fetch per chain regardless of mesh width), the
+KV slot state must REALLY shard (per-chip bytes strictly below global),
+and the compiled decode chain's HLO must pass the collective audit
+(``audit_decode_hlo`` — nothing beyond the whitelisted all-reduces).
+``tp_*`` receipt fields carry the audit verdict and per-chip KV bytes.
+Prints exactly one JSON line (a ``graft-receipt/v1`` envelope) and
+exits non-zero on any failure.
 """
 
 from __future__ import annotations
@@ -87,7 +96,8 @@ import sys
 def selftest(json_path: str | None = None, spec_k: int = 2,
              adapters: int = 3, chaos: bool = False,
              flight: bool = False, pipeline: bool = False,
-             router: bool = False, paged: bool = False) -> dict:
+             router: bool = False, paged: bool = False,
+             tp: int = 0) -> dict:
     import math
     import tempfile
 
@@ -1111,6 +1121,101 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             "chaos_flight_named_slot": named_slot,
         }
 
+    # ------------------------------------------------------------------
+    # tp arm (--tp N, ISSUE 15): the base staggered stream through a
+    # TensorParallel-sharded engine on a {'model': N} mesh. Greedy
+    # tokens must be byte-identical to the replicated base arm (the
+    # Megatron split is an implementation detail), the fetch budget is
+    # unchanged (ONE batched device_get per chain regardless of mesh —
+    # per-shard fetches would multiply the launch roundtrip by tp), the
+    # KV cache must REALLY shard (per-chip bytes < global bytes), and
+    # the compiled decode chain's HLO must contain no collectives
+    # beyond the whitelisted all-reduces (audit_decode_hlo).
+    # ------------------------------------------------------------------
+    tp_fields: dict = {}
+    if tp > 1:
+        from pytorch_distributed_training_tutorials_tpu.models.transformer import TP_RULES
+        from pytorch_distributed_training_tutorials_tpu.parallel import TensorParallel
+        from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+
+        if len(jax.devices()) < tp:
+            problems.append(
+                f"tp arm: {len(jax.devices())} devices < tp={tp}"
+            )
+        else:
+            mesh = create_mesh({"model": tp})
+            eng_tp = ServeEngine(
+                model, params, n_slots=2, tokens_per_launch=8,
+                strategy=TensorParallel(mesh, TP_RULES),
+            )
+            count_tp = {"n": 0}
+
+            def counting_tp(x):
+                count_tp["n"] += 1
+                return real_get(x)
+
+            jax.device_get = counting_tp
+            try:
+                toks_tp = {}
+                pending = list(prompts)
+                for toks, max_new in pending[:2]:
+                    eng_tp.submit(
+                        Request(prompt=toks, max_new_tokens=max_new)
+                    )
+                pending = pending[2:]
+                while not eng_tp.idle or pending:
+                    while pending:
+                        toks, max_new = pending[0]
+                        try:
+                            eng_tp.submit(Request(
+                                prompt=toks, max_new_tokens=max_new
+                            ))
+                            pending.pop(0)
+                        except QueueFull:
+                            break
+                    for c in eng_tp.step():
+                        toks_tp[c.request_id] = c.tokens
+                fetches_tp = count_tp["n"]
+            finally:
+                jax.device_get = real_get
+            tp_exact = all(
+                toks_tp.get(rid) == completions[rid].tokens
+                for rid in range(len(prompts))
+            )
+            if not tp_exact:
+                problems.append(
+                    f"tp={tp} engine changed greedy tokens: {toks_tp}"
+                )
+            tp_budget = eng_tp.n_chains + eng_tp.n_prefills
+            if fetches_tp > tp_budget:
+                problems.append(
+                    f"tp arm: {fetches_tp} host fetches > {tp_budget} "
+                    f"({eng_tp.n_chains} chains + {eng_tp.n_prefills} "
+                    f"prefills) — a per-shard fetch leaked in"
+                )
+            audit = eng_tp.audit_decode_hlo()
+            if not audit["ok"]:
+                problems.append(
+                    f"tp arm: unexpected collectives in the decode "
+                    f"HLO: {audit['problems'][:3]}"
+                )
+            tpstats = eng_tp.stats("tp")
+            from pytorch_distributed_training_tutorials_tpu.serve.slots import tree_nbytes
+            global_kv = tree_nbytes(eng_tp._state["cache"])
+            if tpstats.get("tp_kv_bytes_per_chip", global_kv) >= global_kv:
+                problems.append(
+                    f"tp arm: per-chip KV bytes "
+                    f"{tpstats.get('tp_kv_bytes_per_chip')} not below "
+                    f"global {global_kv} — the cache never sharded"
+                )
+            tp_fields = {
+                "tp_requests": len(prompts),
+                "tp_token_exact": tp_exact,
+                "tp_host_fetches": fetches_tp,
+                "tp_kv_bytes_global": global_kv,
+                **tpstats,
+            }
+
     receipt = make_receipt(
         "serve_selftest",
         {
@@ -1142,6 +1247,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             **paged_fields,
             **router_fields,
             **fault_fields,
+            **tp_fields,
             "problems": problems,
             "ok": not problems,
         },
@@ -1207,6 +1313,14 @@ def main(argv: list[str] | None = None) -> int:
         "the same fetch budget, PoolExhausted shed at submit, and "
         "copy-free page sharing under the prefix cache (ISSUE 13)",
     )
+    parser.add_argument(
+        "--tp", type=int, default=0,
+        help="also run the sharded-serving arm at this TP width: the "
+        "base stream through a TensorParallel engine on a {'model': N} "
+        "mesh, token-identical to replicated, same fetch budget, KV "
+        "really sharded, and a clean decode-HLO collective audit "
+        "(ISSUE 15)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -1228,7 +1342,8 @@ def main(argv: list[str] | None = None) -> int:
     receipt = selftest(args.json, spec_k=args.spec_k,
                        adapters=args.adapters, chaos=args.chaos,
                        flight=args.flight, pipeline=args.pipeline,
-                       router=args.router, paged=args.paged)
+                       router=args.router, paged=args.paged,
+                       tp=args.tp)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

@@ -151,6 +151,9 @@ from pytorch_distributed_training_tutorials_tpu.models.sampling import (
 from pytorch_distributed_training_tutorials_tpu.models.transformer import (
     rewind_cache_index,
 )
+from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import (
+    audit_hlo,
+)
 from pytorch_distributed_training_tutorials_tpu.serve.pages import (
     PagePool,
     PoolExhausted,
@@ -169,6 +172,7 @@ from pytorch_distributed_training_tutorials_tpu.serve.slots import (
     init_slot_state,
     seed_cache,
     tree_nbytes,
+    tree_nbytes_sharded,
     write_slot,
     write_slot_paged,
     zero_cache,
@@ -280,6 +284,7 @@ class ServeEngine:
         paged: bool = False,
         page_size: int = 0,
         pool_pages: int = 0,
+        strategy=None,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -313,6 +318,23 @@ class ServeEngine:
             raise ValueError(
                 "default_deadline_s must be > 0 (None = no deadline)"
             )
+        # sharded serving (ISSUE 15): a TensorParallel strategy shards the
+        # slot/KV state on the model (head) axis to match the attention
+        # sharding the params already carry — TP serving is the existing
+        # engine under jit on a mesh, not a second engine. tp=1 (or
+        # strategy=None) is byte-identical to the replicated engine: the
+        # gate below makes every _pin() a Python-level identity, so no
+        # jaxpr, state leaf, or compile count changes off-path.
+        self._strategy = strategy
+        self._shard = (
+            strategy is not None and getattr(strategy, "tp_size", 1) > 1
+        )
+        self._tp = strategy.tp_size if self._shard else 1
+        self._tp_audit = None
+        # per-chip byte accounting: a sharded leaf's honest HBM claim is
+        # its SHARD size, not its global size (page pricing + prefix
+        # index budgets below go through this)
+        self._nbytes = tree_nbytes_sharded if self._shard else tree_nbytes
         # adapter bank: None = off (the engine then builds byte-identical
         # state and compiled programs to the adapter-free one). On, the
         # engine serves the bank's LoRA twin of the caller's model over
@@ -337,6 +359,12 @@ class ServeEngine:
             # bank version this merge reflects; step() re-merges when
             # the bank moves past it (register/evict on a live engine)
             self._merged_version = adapter_bank.version
+        if self._shard:
+            # commit params to their rule shardings (idempotent for
+            # already-placed trees): committed sharded inputs are what
+            # make every jit below compile GSPMD-sharded programs
+            # instead of replicated ones
+            params = strategy.shard_state(params)
         self.model = model
         self.params = params
         self.n_slots = n_slots
@@ -390,6 +418,7 @@ class ServeEngine:
             history=self.window if self._spec else 0,
             adapters=self._adapters,
             paged=self._pool_pages if self._paged else 0,
+            strategy=strategy if self._shard else None,
         )
         self._scan_layers = bool(getattr(model.cfg, "scan_layers", False))
         if self._paged:
@@ -402,7 +431,7 @@ class ServeEngine:
                 jax.tree_util.tree_leaves_with_path(self._state["cache"])
                 if _leaf_name(path) in _POOL_TO_FLAT
             ]
-            self._page_bytes = tree_nbytes(pool_leaves) // self._pool_pages
+            self._page_bytes = self._nbytes(pool_leaves) // self._pool_pages
         else:
             self._page_bytes = 0
         self._temperature = float(temperature)
@@ -544,7 +573,9 @@ class ServeEngine:
         # side cache IS donated between chunks (it has exactly one
         # consumer), as is the slot state into the final splice.
         if self._chunk:
-            self._chunk_zero = jax.jit(lambda: zero_cache(self._proto1))
+            self._chunk_zero = jax.jit(
+                lambda: self._pin(zero_cache(self._proto1))
+            )
             self._chunk_step = jax.jit(
                 self._chunk_step_fn, donate_argnums=donate
             )
@@ -564,9 +595,9 @@ class ServeEngine:
                 )
             else:
                 self._chunk_seed = jax.jit(
-                    lambda segment, depth: seed_cache(
+                    lambda segment, depth: self._pin(seed_cache(
                         self._proto1, segment, depth
-                    )
+                    ))
                 )
                 self._chunk_final = jax.jit(
                     self._chunk_final_fn,
@@ -577,6 +608,24 @@ class ServeEngine:
     # ------------------------------------------------------------------
     # compiled programs (closures over model + static sampling params)
     # ------------------------------------------------------------------
+
+    def _pin(self, tree):
+        """Pin ``tree``'s cache leaves to the strategy's slot shardings.
+
+        Sharded engines thread this through every compiled cache
+        producer (prefill write, splice seed, chunk accumulate, chain
+        carry) so GSPMD keeps K/V head-sharded END TO END — without the
+        constraint, a DUS or gather whose index operands are replicated
+        can tempt the partitioner into an all-gather + local-update +
+        reshard round trip. Off-path (``strategy=None`` or tp=1) this is
+        a Python-level identity: no constraint op enters the jaxpr, so
+        the unsharded engine's compiled programs stay byte-identical
+        (the same off-path trick as guard/chaos/spec/adapters). Specs
+        resolve from the traced leaf shapes, so the ONE helper covers
+        slot caches, batch-1 segments, and side caches alike."""
+        if not self._shard:
+            return tree
+        return self._strategy.constrain_slot_tree(tree)
 
     def _prefill_fn(self, params, state, tokens, p_len, slot, seed,
                     max_new, aid=0):
@@ -611,13 +660,13 @@ class ServeEngine:
             logits[:, -1].astype(jnp.float32), key,
             self._temperature, self._top_k, self._top_p,
         )
-        cache = write_slot(
+        cache = self._pin(write_slot(
             state["cache"], upd["cache"], slot, p_len, self._scan_layers
-        )
+        ))
         seg = (
-            extract_segment(
+            self._pin(extract_segment(
                 upd["cache"], tokens.shape[1], self._scan_layers
-            )
+            ))
             if self._retain
             else ()
         )
@@ -670,7 +719,7 @@ class ServeEngine:
         kw = {}
         if self._adapters:
             kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
-        cache1 = seed_cache(self._proto1, segment, depth)
+        cache1 = self._pin(seed_cache(self._proto1, segment, depth))
         return self._finish_prefill(
             params, cache1, state, suffix, p_len - 1 - depth, full,
             p_len, slot, seed, max_new, aid, kw, seg_len, grow,
@@ -694,11 +743,13 @@ class ServeEngine:
             logits[:, -1].astype(jnp.float32), key,
             self._temperature, self._top_k, self._top_p,
         )
-        cache = write_slot(
+        cache = self._pin(write_slot(
             state["cache"], upd["cache"], slot, p_len, self._scan_layers
-        )
+        ))
         seg = (
-            extract_segment(upd["cache"], seg_len, self._scan_layers)
+            self._pin(
+                extract_segment(upd["cache"], seg_len, self._scan_layers)
+            )
             if grow
             else ()
         )
@@ -734,7 +785,7 @@ class ServeEngine:
             {"params": params, "cache": cache1}, tokens, decode=True,
             mutable=["cache"], last_pos=0, **kw,
         )
-        return upd["cache"]
+        return self._pin(upd["cache"])
 
     def _chunk_final_fn(self, params, cache1, state, suffix, full,
                         last_local, p_len, slot, seed, max_new, aid=0,
@@ -785,10 +836,10 @@ class ServeEngine:
             logits[:, -1].astype(jnp.float32), key,
             self._temperature, self._top_k, self._top_p,
         )
-        cache = write_slot_paged(
+        cache = self._pin(write_slot_paged(
             state["cache"], upd["cache"], row, slot, p_len,
             self._page_size, self._scan_layers,
-        )
+        ))
         new_state = {
             "cache": cache,
             "last_tok": state["last_tok"].at[slot].set(first[0]),
@@ -886,9 +937,9 @@ class ServeEngine:
                 )
             return new1  # pool leaf: the updated pool IS the new pool
 
-        cache = jax.tree_util.tree_map_with_path(
+        cache = self._pin(jax.tree_util.tree_map_with_path(
             merge, cache, upd["cache"]
-        )
+        ))
         new_state = {
             "cache": cache,
             "last_tok": state["last_tok"].at[slot].set(first[0]),
@@ -970,7 +1021,9 @@ class ServeEngine:
                 out = g.reshape((1, -1) + g.shape[2:])
             return out.astype(proto.dtype)
 
-        return jax.tree_util.tree_map_with_path(build, self._proto1)
+        return self._pin(
+            jax.tree_util.tree_map_with_path(build, self._proto1)
+        )
 
     def _chunk_final_paged_fn(self, params, cache1, state, suffix, full,
                               last_local, p_len, slot, seed, max_new,
@@ -993,10 +1046,10 @@ class ServeEngine:
             logits[:, -1].astype(jnp.float32), key,
             self._temperature, self._top_k, self._top_p,
         )
-        cache = write_slot_paged(
+        cache = self._pin(write_slot_paged(
             state["cache"], upd["cache"], row, slot, p_len,
             self._page_size, self._scan_layers,
-        )
+        ))
         new_state = {
             "cache": cache,
             "last_tok": state["last_tok"].at[slot].set(first[0]),
@@ -1076,7 +1129,7 @@ class ServeEngine:
                 (nxt, jnp.all(jnp.isfinite(row), axis=-1))
                 if guard else nxt
             )
-            return (upd["cache"], nxt, keys, remaining), out
+            return (self._pin(upd["cache"]), nxt, keys, remaining), out
 
         carry = (
             state["cache"], state["last_tok"], state["keys"],
@@ -1170,7 +1223,7 @@ class ServeEngine:
             )
             # the verify forward advanced every counter by k+1; the slot
             # really produced 1 + n_acc tokens, so rewind the rest
-            cache = rewind_cache_index(upd["cache"], k - n_acc)
+            cache = self._pin(rewind_cache_index(upd["cache"], k - n_acc))
             n_emit = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
             new_tok = jnp.where(active, emitted[rows, n_acc], tok)
             cols = jnp.where(
@@ -1656,7 +1709,7 @@ class ServeEngine:
                 self.n_prefills += 1
             if grow:
                 self.prefix.insert(
-                    tuple(pkey), new_seg, tree_nbytes(new_seg)
+                    tuple(pkey), new_seg, self._nbytes(new_seg)
                 )
             first = int(jax.device_get(first))
         except Exception:
@@ -2029,7 +2082,7 @@ class ServeEngine:
                     )
                 else:
                     self.prefix.insert(
-                        tuple(pend.pkey), new_seg, tree_nbytes(new_seg)
+                        tuple(pend.pkey), new_seg, self._nbytes(new_seg)
                     )
             first = int(jax.device_get(first))
         except Exception:
@@ -2318,7 +2371,13 @@ class ServeEngine:
         this is a non-event for in-flight traffic)."""
         if not self._adapters:
             raise ValueError("engine has no adapter bank")
-        self.params = self._bank.merge_params(self._base_params)
+        merged = self._bank.merge_params(self._base_params)
+        if self._shard:
+            # keep the re-merged tree committed to its rule shardings —
+            # an uncommitted replacement would silently recompile every
+            # program against replicated params
+            merged = self._strategy.shard_state(merged)
+        self.params = merged
         self._merged_version = self._bank.version
         if self._flight is not None:
             self._flight.record(
@@ -2387,9 +2446,58 @@ class ServeEngine:
             **{f"pages_{k}": v for k, v in self._pool.stats().items()},
         }
 
+    def audit_decode_hlo(
+        self, whitelist: tuple[str, ...] = ("all-reduce",)
+    ) -> dict:
+        """Compile the decode chain AOT and audit its HLO for
+        collectives (ISSUE 15): a correctly head-sharded engine's chain
+        contains ONLY attention/FFN all-reduces — an all-gather or a
+        reshard copy means a slot leaf lost its sharding somewhere and
+        the per-chip HBM claim is a lie. Returns (and caches, for
+        :meth:`tp_stats`) :func:`..parallel.tensor_parallel.audit_hlo`'s
+        verdict dict.
+
+        EXPLICIT, never automatic: ``lower().compile()`` is an AOT
+        compile that does NOT populate the jit dispatch cache, so
+        auditing costs one extra chain compile — fine on the CPU test
+        mesh or once per receipt run, not something to hide in the
+        constructor of a 1.2B engine."""
+        args = [self.params, self._state]
+        if self._inject_logits:
+            args.append(jnp.asarray(0, jnp.int32))
+        hlo = self._chain.lower(*args).compile().as_text()
+        self._tp_audit = audit_hlo(hlo, whitelist=whitelist)
+        return self._tp_audit
+
+    def tp_stats(self) -> dict[str, int | float | str | bool]:
+        """Sharded-serving fields for the receipt (ISSUE 15): tp size +
+        mesh shape (config — regress.py fingerprints ``tp`` /
+        ``mesh_shape`` so sharded and replicated rounds never gate each
+        other) and the PER-CHIP KV footprint (shard sizes, the honest
+        HBM claim). ``tp_collectives`` / ``tp_hlo_ok`` appear only
+        after an explicit :meth:`audit_decode_hlo` (outcomes, excluded
+        from the fingerprint). Host metadata only — sharding math, no
+        device fetch."""
+        if not self._shard:
+            return {"tp": 1}
+        out: dict[str, int | float | str | bool] = {
+            "tp": self._tp,
+            "mesh_shape": ",".join(
+                f"{k}:{v}"
+                for k, v in self._strategy.mesh.shape.items()
+            ),
+            "tp_kv_bytes_per_chip": self._nbytes(self._state["cache"]),
+        }
+        if self._tp_audit is not None:
+            out["tp_collectives"] = sum(
+                self._tp_audit["collectives"].values()
+            )
+            out["tp_hlo_ok"] = self._tp_audit["ok"]
+        return out
+
     _STATS_PARTS = (
         "prefix", "spec", "adapters", "fault", "flight", "pipeline",
-        "pages",
+        "pages", "tp",
     )
 
     def stats(self, *parts: str) -> dict[str, int | float]:
@@ -2415,6 +2523,7 @@ class ServeEngine:
             "flight": self.flight_stats,
             "pipeline": self.pipeline_stats,
             "pages": self.page_stats,
+            "tp": self.tp_stats,
         }
         out: dict[str, int | float] = {}
         for part in self._STATS_PARTS:

@@ -28,7 +28,11 @@ Design rules (the engine's paged contracts lean on every one):
 - **Lowest-id-first reuse** (a heap, not a LIFO stack) keeps the pool's
   occupied region dense, which makes the ``high_water`` counter an
   honest HBM high-water mark: ``high_water * page_bytes`` is the most
-  pool memory that was ever live at once.
+  pool memory that was ever live at once. On a tensor-parallel engine
+  (ISSUE 15) ``page_bytes`` is priced PER SHARD — the pool leaves are
+  head-sharded across the mesh, so a page costs each chip 1/tp of its
+  global bytes (``slots.tree_nbytes_sharded``; the engine picks the
+  pricing fn, this allocator never sees device arrays either way).
 
 Host-only by contract: importing this module must not touch jax
 (tests/test_prefix.py pins it in a subprocess alongside

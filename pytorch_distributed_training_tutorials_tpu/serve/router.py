@@ -892,6 +892,10 @@ class FleetRouter:
         "adapters", "n_adapters", "lora_rank", "deadline_s",
         "guard_nonfinite", "chaos", "flight", "pipeline_depth",
         "prefill_chunk",
+        # sharded serving (ISSUE 15): identical across a homogeneous
+        # fleet (one mesh geometry, one compiled program set) — summing
+        # tp sizes or and-ing audit booleans would both lie
+        "tp", "mesh_shape", "tp_collectives", "tp_hlo_ok",
     })
     # Derived ratios: recomputed or dropped rather than summed.
     _RATIO_STAT_KEYS = frozenset({

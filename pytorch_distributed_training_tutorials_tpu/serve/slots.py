@@ -58,7 +58,8 @@ def bucket_len(p_len: int, window: int, floor: int = 8) -> int:
 
 
 def init_slot_state(model, params, n_slots: int, history: int = 0,
-                    adapters: bool = False, paged: int = 0):
+                    adapters: bool = False, paged: int = 0,
+                    strategy=None):
     """Zero-initialized slot-state pytree for ``n_slots`` concurrent
     requests of ``model`` (a :class:`..models.transformer.TransformerLM`
     or anything sharing its cache contract).
@@ -104,6 +105,14 @@ def init_slot_state(model, params, n_slots: int, history: int = 0,
     range), so an unbacked slot's decode writes DROP instead of
     corrupting pool pages (see ``models/transformer.py
     _store_paged_kv``).
+
+    ``strategy`` (a :class:`..parallel.tensor_parallel.TensorParallel`
+    with ``tp_size > 1``; ISSUE 15) places the finished tree per the
+    strategy's slot rules — K/V (and pool) leaves head-sharded to match
+    the attention split, bookkeeping replicated. Committed sharded
+    inputs are what make the engine's jits compile GSPMD-sharded decode
+    programs; ``strategy=None`` (or tp 1) leaves placement untouched,
+    byte-identical to the pre-sharding builder.
     """
     if n_slots < 1:
         raise ValueError("n_slots must be >= 1")
@@ -139,6 +148,8 @@ def init_slot_state(model, params, n_slots: int, history: int = 0,
         state["hist_len"] = jnp.zeros((n_slots,), jnp.int32)
     if adapters:
         state["adapter_ids"] = jnp.zeros((n_slots,), jnp.int32)
+    if strategy is not None and getattr(strategy, "tp_size", 1) > 1:
+        state = strategy.shard_slot_state(state)
     return state
 
 
@@ -315,6 +326,26 @@ def tree_nbytes(tree) -> int:
         math.prod(leaf.shape) * jnp.dtype(leaf.dtype).itemsize
         for leaf in jax.tree_util.tree_leaves(tree)
     )
+
+
+def tree_nbytes_sharded(tree) -> int:
+    """Per-DEVICE bytes of a pytree's array leaves: each leaf priced at
+    its shard shape (``sharding.shard_shape``) instead of its global
+    shape, so a head-sharded KV segment on a tp-wide mesh costs
+    ``1/tp`` of its global bytes — the honest per-chip HBM claim
+    (ISSUE 15). Falls back to global shape for leaves without a
+    concrete sharding (eval_shape structs, plain numpy), making it a
+    drop-in for :func:`tree_nbytes` on replicated trees. Metadata only —
+    never a device fetch."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        shape = (
+            sharding.shard_shape(leaf.shape)
+            if sharding is not None else leaf.shape
+        )
+        total += math.prod(shape) * jnp.dtype(leaf.dtype).itemsize
+    return total
 
 
 def _leaf_name(path) -> str:

@@ -4,10 +4,10 @@ Every feature since round 5 shipped with its real-chip receipt recipe
 documented but NOT taken (no tunnel window in those sessions): the
 fused train-step tail, the --server base arm, prefix splicing,
 speculation, multi-tenant adapters, deadlines, the flight recorder,
-request-loop pipelining, the fleet router, and now the paged KV pool.
-This script is the catch-up: it sequences all ten arms so the next
-session with a chip runs ONE command instead of re-deriving ten
-recipes from CLAUDE.md prose.
+request-loop pipelining, the fleet router, the paged KV pool, and now
+tensor-parallel serving. This script is the catch-up: it sequences all
+eleven arms so the next session with a chip runs ONE command instead
+of re-deriving eleven recipes from CLAUDE.md prose.
 
 Sequencing is the point — every serving arm shares one --ckpt_dir, so
 the ~10-min cold 1.2B quantize-on-load cost is paid exactly once (by
@@ -50,6 +50,7 @@ ARM_NAMES = (
     "pipeline",    # --pipeline-depth 2: wall tok/s vs device rate
     "fleet",       # --replicas 2 --qps 8: aggregate tok/s + ledger_ok
     "paged",       # --paged @ 4096 window: hbm_high_water_bytes claim
+    "tp",          # --tp 4: head-sharded decode, per-chip KV at 1/tp
 )
 
 
@@ -98,6 +99,10 @@ def build_session(round_no: int, ckpt_dir: str, out_dir: str):
         # long-window paged arm: slot count decoupled from the 4096
         # window; the interesting receipt field is hbm_high_water_bytes
         srv("paged", "--max_seq_len", "4096", "--paged"),
+        # tensor-parallel arm: head-sharded decode over the model axis;
+        # the interesting fields are tp_kv_bytes_per_chip (1/tp of the
+        # global cache) and tp_hlo_ok at tok/s within a few % of base
+        srv("tp", "--tp", "4"),
     ]
 
 

@@ -78,6 +78,12 @@ The load-bearing pins:
   the summed per-replica fetch budget intact, and a chaos-killed
   replica's queued work re-dispatches token-identically with the
   ``DispatchLedger`` verifying exactly-once delivery;
+- sharded serving (ISSUE 15) rides the same machinery: the
+  ``--selftest --tp 2`` arm replays the base stream through a
+  head-sharded engine and pins token-exactness, the unchanged fetch
+  budget, the all-reduce-only decode HLO audit, and per-chip KV bytes
+  at 1/tp of global (tests/test_tp_serve.py holds the in-process
+  pins);
 - ``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` succeeds in a
   subprocess (the tier-1 wiring for the end-to-end smoke), and the
   ``--chaos`` / ``--router`` arms exercise the fault and fleet paths
@@ -2470,4 +2476,33 @@ def test_serve_selftest_paged_subprocess(tmp_path):
     assert receipt["paged_prefix_shares"] >= 1
     assert receipt["pages_in_use"] == 0
     assert receipt["hbm_high_water_bytes"] > 0
+    assert load_receipt(json_path)["ok"] is True
+
+
+@pytest.mark.slow
+def test_serve_selftest_tp_subprocess(tmp_path):
+    """``--selftest --tp 2`` — the ISSUE 15 arm: the base staggered
+    stream replayed through a head-sharded engine is token-identical
+    with the fetch budget intact (one batched fetch per chain), the
+    compiled decode chain audits all-reduce-only, and per-chip KV
+    bytes land at half the global cache — all counted into the
+    receipt."""
+    from pytorch_distributed_training_tutorials_tpu.obs import load_receipt, validate_receipt
+
+    json_path = str(tmp_path / "selftest_tp.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_training_tutorials_tpu.serve", "--selftest",
+         "--tp", "2", "--json", json_path],
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+        env=os.environ.copy(),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    receipt = json.loads(out.stdout.strip().splitlines()[-1])
+    assert receipt["ok"] is True, receipt.get("problems")
+    assert validate_receipt(receipt, kind="serve_selftest") == []
+    assert receipt["tp"] == 2 and receipt["mesh_shape"] == "model:2"
+    assert receipt["tp_token_exact"] is True
+    assert receipt["tp_hlo_ok"] is True and receipt["tp_collectives"] > 0
+    assert receipt["tp_kv_bytes_per_chip"] < receipt["tp_kv_bytes_global"]
+    assert receipt["tp_host_fetches"] > 0
     assert load_receipt(json_path)["ok"] is True

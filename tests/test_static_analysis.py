@@ -416,6 +416,43 @@ def test_host_sync_pipelined_chain_fetch_contract():
     assert not hits(check(clean), "host-sync-hazard")
 
 
+def test_host_sync_per_shard_fetch_loop():
+    """The ISSUE 15 foot-gun pair: collecting a sharded chain result by
+    looping ``jax.device_get`` over shards inside the traced body fires
+    host-sync-hazard (one sync per shard per launch — the per-LAUNCH
+    floor sharded serving must not multiply by tp), while the engine's
+    idiom — ONE batched ``jax.device_get`` of the replicated token
+    block at host level, sharded cache leaves never fetched — stays
+    silent."""
+    bad = """
+        import jax
+
+        @jax.jit
+        def collect(state, shards):
+            outs = []
+            for s in shards:             # one host sync PER SHARD
+                outs.append(jax.device_get(s))
+            return state, outs
+    """
+    found = hits(check(bad), "host-sync-hazard")
+    assert [f.line for f in found] == [8]
+
+    clean = """
+        import jax
+
+        @jax.jit
+        def chain(state):
+            return state, state * 2
+
+        def collect(state):
+            # the sharded engine fetches ONCE, at host level, and only
+            # the replicated token block — never the head-sharded cache
+            state, out = chain(state)
+            return state, jax.device_get(out)
+    """
+    assert not hits(check(clean), "host-sync-hazard")
+
+
 def test_host_sync_silent_outside_jit():
     src = """
         import time

@@ -16,7 +16,11 @@ from pytorch_distributed_training_tutorials_tpu.models import (
     TransformerConfig,
     TransformerLM,
 )
-from pytorch_distributed_training_tutorials_tpu.parallel import TensorParallel
+from pytorch_distributed_training_tutorials_tpu.parallel import (
+    SLOT_STATE_RULES,
+    TensorParallel,
+    audit_hlo,
+)
 from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
 from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import (
     spec_for_path,
@@ -119,3 +123,119 @@ def test_tp_audit_lines():
     )
     lines = tp.audit(abstract["params"])
     assert any("q_proj/kernel" in l and "'model'" in l for l in lines)
+
+
+# ---- sharded-serving spec table + audit (ISSUE 15) ----------------------
+
+
+def test_slot_state_rules_resolution():
+    """Every slot-state leaf family resolves to its documented spec:
+    K/V + scales head-sharded (trailing-dim rules so scan's leading
+    layer axis left-pads), bookkeeping leaves replicated."""
+    mesh = create_mesh({"data": 2, "model": 4})
+    # unrolled cache (slots, W, heads, dim)
+    assert spec_for_path(
+        "cache/block_0/attn/cached_key", 4, SLOT_STATE_RULES,
+        mesh=mesh, shape=(2, 64, 4, 16),
+    ) == P(None, None, "model", None)
+    # scan layout: leading layer axis gets left-padded None
+    assert spec_for_path(
+        "cache/layers/block/attn/cached_value", 5, SLOT_STATE_RULES,
+        mesh=mesh, shape=(2, 2, 64, 4, 16),
+    ) == P(None, None, None, "model", None)
+    # int8-KV scales (slots, W, heads) — the $-anchored bare K/V rule
+    # cannot swallow the _scale leaf regardless of rule order
+    assert spec_for_path(
+        "cache/block_0/attn/cached_key_scale", 3, SLOT_STATE_RULES,
+        mesh=mesh, shape=(2, 64, 4),
+    ) == P(None, None, "model")
+    # paged pool leaves (pool_pages, page_size, heads, dim)
+    assert spec_for_path(
+        "cache/block_0/attn/paged_value", 4, SLOT_STATE_RULES,
+        mesh=mesh, shape=(16, 8, 4, 16),
+    ) == P(None, None, "model", None)
+    assert spec_for_path(
+        "cache/block_0/attn/paged_key_scale", 3, SLOT_STATE_RULES,
+        mesh=mesh, shape=(16, 8, 4),
+    ) == P(None, None, "model")
+    # bookkeeping falls through to replicated
+    for path, ndim in [
+        ("cache/block_0/attn/cache_index", 1),
+        ("cache/block_0/attn/page_table", 2),
+        ("last_tok", 2),
+        ("keys", 2),
+        ("remaining", 1),
+        ("hist", 2),
+        ("adapter_ids", 1),
+    ]:
+        assert spec_for_path(path, ndim, SLOT_STATE_RULES) == P()
+
+
+def test_slot_state_rules_gqa_degenerates_replicated():
+    """A kv_heads dim the model axis does not divide drops to
+    replicated (GQA n_kv_heads=2 under tp=4) instead of erroring."""
+    mesh = create_mesh({"data": 2, "model": 4})
+    assert spec_for_path(
+        "cache/block_0/attn/cached_key", 4, SLOT_STATE_RULES,
+        mesh=mesh, shape=(2, 64, 2, 16),
+    ) == P(None, None, None, None)
+    # but 4 kv heads under tp=2 shards fine
+    mesh2 = create_mesh({"model": 2}, devices=jax.devices()[:2])
+    assert spec_for_path(
+        "cache/block_0/attn/cached_key", 4, SLOT_STATE_RULES,
+        mesh=mesh2, shape=(2, 64, 4, 16),
+    ) == P(None, None, "model", None)
+
+
+def test_audit_slot_state_flags_replicated_kv():
+    """audit(params, slot_state=...) walks the slot tree and appends
+    the actionable WARNING on KV leaves that resolved replicated under
+    tp > 1 (the mis-sharded-cache signal), while properly sharded
+    leaves and bookkeeping stay warning-free."""
+    mesh = create_mesh({"data": 2, "model": 4})
+    tp = TensorParallel(mesh, TP_RULES)
+    slot_state = {
+        "cached_key": jax.ShapeDtypeStruct((2, 64, 2, 16), jnp.bfloat16),
+        "cached_value": jax.ShapeDtypeStruct((2, 64, 4, 16), jnp.bfloat16),
+        "cache_index": jax.ShapeDtypeStruct((2,), jnp.int32),
+    }
+    lines = tp.audit({}, slot_state=slot_state)
+    bad = [l for l in lines if "WARNING" in l]
+    assert len(bad) == 1 and "cached_key" in bad[0]
+    assert "tp=4" in bad[0] and "divides the head dim" in bad[0]
+    ok = [l for l in lines if "cached_value" in l]
+    assert len(ok) == 1 and "'model'" in ok[0] and "WARNING" not in ok[0]
+    idx = [l for l in lines if "cache_index" in l]
+    assert len(idx) == 1 and "WARNING" not in idx[0]
+
+
+def test_audit_slot_state_quiet_at_tp1():
+    """No model axis on the mesh -> replicated KV is the CORRECT layout,
+    so the audit must not warn."""
+    mesh = create_mesh({"data": 2}, devices=jax.devices()[:2])
+    tp = TensorParallel(mesh, TP_RULES)
+    slot_state = {
+        "cached_key": jax.ShapeDtypeStruct((2, 64, 4, 16), jnp.bfloat16),
+    }
+    lines = tp.audit({}, slot_state=slot_state)
+    assert lines and all("WARNING" not in l for l in lines)
+
+
+def test_audit_hlo_whitelist():
+    """audit_hlo counts collective kinds, flags non-whitelisted lines,
+    matches async -start variants once and never their -done halves."""
+    hlo = "\n".join([
+        "  %ar = bf16[4]{0} all-reduce(bf16[4]{0} %x), to_apply=%add",
+        "  %ars = bf16[4]{0} all-reduce-start(bf16[4]{0} %y)",
+        "  %ard = bf16[4]{0} all-reduce-done(bf16[4]{0} %ars)",
+        "  %ag = bf16[8]{0} all-gather(bf16[4]{0} %z), dimensions={0}",
+        "  %fusion = bf16[4]{0} fusion(bf16[4]{0} %w), kind=kLoop",
+    ])
+    rep = audit_hlo(hlo)
+    assert rep["collectives"] == {"all-reduce": 2, "all-gather": 1}
+    assert not rep["ok"]
+    assert len(rep["problems"]) == 1 and "all-gather" in rep["problems"][0]
+    # widen the whitelist -> same counts, clean verdict
+    rep2 = audit_hlo(hlo, whitelist=("all-reduce", "all-gather"))
+    assert rep2["ok"] and rep2["problems"] == []
+    assert audit_hlo("no collectives here")["ok"]
