@@ -5,6 +5,13 @@ numpy, flax, ...): it has to run in milliseconds, run before any backend
 exists, and be structurally incapable of violating the import-purity rule
 it enforces. ``tests/test_static_analysis.py`` pins the no-jax property.
 
+The sweep is two-phase (v2): phase 1 parses every file and builds one
+shared :class:`~.engine.SweepContext` whose :class:`~.modgraph.ModuleGraph`
+is the package-wide import graph; phase 2 runs rules per file. Per-file
+rules read only their own context; cross-module rules query ``ctx.sweep``
+— that's how a transitive ``import jax`` two hops below a host-only
+module becomes visible.
+
 The CLAUDE.md hard rules it machine-checks, by rule id:
 
 - ``import-purity``      — no jax computation at import time (module level,
@@ -17,6 +24,20 @@ The CLAUDE.md hard rules it machine-checks, by rule id:
 - ``host-sync-hazard``   — no device_get/block_until_ready/np.asarray
                            inside traced bodies
 - ``reference-citation`` — docstring file:line citations parse and resolve
+- ``naive-timing``       — perf_counter regions in jax-importing files must
+                           close with a real device fetch
+- ``jax-free-host``      — modules declared host-only (``hostonly.py``, the
+                           same constant the runtime subprocess pin reads)
+                           are TRANSITIVELY jax-free over the import graph
+- ``fetch-budget``       — host syncs in serve/ only at the budgeted call
+                           sites (the chains + prefills + splices contract)
+- ``engine-static``      — per-request data must not reach shapes,
+                           static_argnums/argnames, or conditional program
+                           construction (the recompile-per-request hazard)
+
+Plus the engine pseudo-rules: ``parse-error``, ``bad-suppression``, and
+``unused-suppression`` (a reasoned disable that silenced zero findings is
+itself reported — stale claims rot the audit trail).
 
 Suppress a finding inline, reason mandatory::
 

@@ -36,7 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
         description=(
             "AST-based enforcement of this repo's TPU-correctness "
             "invariants (import purity, traced control flow, strategy "
-            "interface, host-sync hazards, reference citations). "
+            "interface, host-sync hazards, reference citations) plus the "
+            "whole-program sweep rules (transitive jax-freeness of "
+            "host-only modules, serve/ fetch budget, engine-static "
+            "recompile hazards, suppression hygiene). "
             "Pure stdlib: never imports jax."
         ),
     )
@@ -46,8 +49,9 @@ def build_parser() -> argparse.ArgumentParser:
              + ", ".join(DEFAULT_PATHS) + " where present)",
     )
     parser.add_argument(
-        "--select", metavar="RULES",
-        help="comma-separated rule ids to run (default: all)",
+        "--rules", "--select", dest="select", metavar="RULES",
+        help="comma-separated rule ids to run (default: all); "
+             "--select is the back-compat spelling",
     )
     parser.add_argument(
         "--json", action="store_true", help="emit a JSON report on stdout"
@@ -105,10 +109,17 @@ def main(argv: list[str] | None = None) -> int:
     suppressed = [f for f in findings if f.suppressed]
 
     if args.json:
+        rule_counts: dict[str, int] = {}
+        for f in unsuppressed:
+            rule_counts[f.rule] = rule_counts.get(f.rule, 0) + 1
         print(json.dumps({
+            # Versioned envelope (graftcheck-report/v1): consumers key on
+            # `schema` before trusting field layout, like graft-receipt/v1.
+            "schema": "graftcheck-report/v1",
             "files": n_files,
             "elapsed_s": round(elapsed, 3),
             "rules": [r.id for r in rules],
+            "rule_counts": dict(sorted(rule_counts.items())),
             "unsuppressed": len(unsuppressed),
             "suppressed": len(suppressed),
             "findings": [f.to_dict() for f in findings],

@@ -1,12 +1,21 @@
-"""graftcheck engine: parse each file once, run every rule, apply
-suppressions.
+"""graftcheck engine: two-phase sweep — index every file, then run rules.
+
+Phase 1 (index) parses every swept file into a :class:`FileContext` and
+binds them all to one shared :class:`SweepContext`, whose lazily-built
+:class:`~.modgraph.ModuleGraph` gives rules the whole-program view the
+per-file engine of PR 1 lacked (a transitive ``import jax`` two hops
+below a host-only module is invisible to any single file's AST). Phase 2
+runs every rule over every file; per-file rules read only their own
+context, cross-module rules (``jax-free-host``) query ``ctx.sweep``.
 
 The engine owns everything rules share — the parsed tree, the import map,
-the traced-context index — as lazy cached properties on
-:class:`FileContext`, so adding a rule never re-parses or re-walks. It also
-owns the two pseudo-rules no Rule class can express: ``parse-error`` (the
-file did not parse; nothing else can be checked) and ``bad-suppression``
-(a suppression comment with no reason or an unknown rule id).
+the traced-context index, the module graph — as lazy cached properties,
+so adding a rule never re-parses or re-walks. It also owns the three
+pseudo-rules no Rule class can express: ``parse-error`` (the file did not
+parse; nothing else can be checked), ``bad-suppression`` (a suppression
+comment with no reason or an unknown rule id), and ``unused-suppression``
+(a reasoned suppression that silenced zero findings — stale claims rot
+the audit trail; only judged when every rule it names actually ran).
 """
 
 from __future__ import annotations
@@ -19,7 +28,12 @@ from typing import Iterable, Sequence
 
 from pytorch_distributed_training_tutorials_tpu.analysis import registry, suppressions
 from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding, sort_key
+from pytorch_distributed_training_tutorials_tpu.analysis.hostonly import (
+    FORBIDDEN_IMPORT_ROOTS,
+    HOST_ONLY_MODULES,
+)
 from pytorch_distributed_training_tutorials_tpu.analysis.jitscope import JitContext, discover
+from pytorch_distributed_training_tutorials_tpu.analysis.modgraph import ModuleGraph
 from pytorch_distributed_training_tutorials_tpu.analysis.names import ImportMap
 
 _SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
@@ -34,6 +48,25 @@ class Config:
     reference_root: Path = Path("/root/reference")
     # Repo root for repo-internal citations; autodetected per file when None.
     repo_root: Path | None = None
+    # Modules declared host-only (transitively jax-free) and the import
+    # roots that violate the declaration — the jax-free-host rule's
+    # inputs. Defaults to the repo's single-sourced declaration
+    # (analysis/hostonly.py), overridable for fixtures.
+    host_only_modules: tuple[str, ...] = HOST_ONLY_MODULES
+    forbidden_import_roots: tuple[str, ...] = FORBIDDEN_IMPORT_ROOTS
+
+
+@dataclass
+class SweepContext:
+    """What the whole sweep knows: every parsed file, plus the lazily-built
+    import graph cross-module rules query."""
+
+    contexts: list["FileContext"]
+    config: Config = field(default_factory=Config)
+
+    @cached_property
+    def modgraph(self) -> ModuleGraph:
+        return ModuleGraph((c.path, c.tree) for c in self.contexts)
 
 
 @dataclass
@@ -44,6 +77,9 @@ class FileContext:
     source: str
     tree: ast.AST
     config: Config = field(default_factory=Config)
+    # The sweep this file was analyzed in; single-file analysis gets a
+    # degenerate one-file sweep so rules can always query it.
+    sweep: SweepContext | None = None
 
     @cached_property
     def import_map(self) -> ImportMap:
@@ -79,40 +115,34 @@ def iter_python_files(paths: Iterable[str | Path]) -> list[Path]:
     return list(seen)
 
 
-def analyze_file(
-    path: str | Path,
-    rules: Sequence[registry.Rule] | None = None,
-    config: Config | None = None,
-    source: str | None = None,
-) -> list[Finding]:
-    """All findings for one file, suppression state applied."""
-    path = Path(path)
-    config = config or Config()
-    if rules is None:
-        rules = list(registry.all_rules().values())
-    if source is None:
-        source = path.read_text(encoding="utf-8")
-
+def _parse(path: Path, source: str, config: Config
+           ) -> FileContext | Finding:
+    """Index one file: a FileContext, or the parse-error finding."""
     try:
         tree = ast.parse(source, filename=str(path))
     except SyntaxError as exc:
-        return [Finding(
+        return Finding(
             rule=registry.PARSE_ERROR,
             path=str(path),
             line=exc.lineno or 1,
             col=(exc.offset or 1) - 1,
             message=f"file does not parse: {exc.msg}",
-        )]
+        )
     except ValueError as exc:  # e.g. null bytes in source
-        return [Finding(
+        return Finding(
             rule=registry.PARSE_ERROR,
             path=str(path),
             line=1,
             col=0,
             message=f"file does not parse: {exc}",
-        )]
+        )
+    return FileContext(path=path, source=source, tree=tree, config=config)
 
-    ctx = FileContext(path=path, source=source, tree=tree, config=config)
+
+def _check_context(
+    ctx: FileContext, rules: Sequence[registry.Rule]
+) -> list[Finding]:
+    """Phase 2 for one file: rules, dedupe, suppression accounting."""
     findings: list[Finding] = []
     for rule in rules:
         findings.extend(rule.check(ctx))
@@ -124,14 +154,14 @@ def analyze_file(
         deduped.setdefault((f.rule, f.line, f.col, f.message), f)
     findings = list(deduped.values())
 
-    sups = suppressions.collect(source)
+    sups = suppressions.collect(ctx.source)
     known = registry.known_rule_ids()
     for sup in sups:
         unknown = sup.rules - known
         if unknown:
             findings.append(Finding(
                 rule=registry.BAD_SUPPRESSION,
-                path=str(path),
+                path=str(ctx.path),
                 line=sup.comment_line,
                 col=0,
                 message=(
@@ -142,7 +172,7 @@ def analyze_file(
         if not sup.reason:
             findings.append(Finding(
                 rule=registry.BAD_SUPPRESSION,
-                path=str(path),
+                path=str(ctx.path),
                 line=sup.comment_line,
                 col=0,
                 message=(
@@ -155,6 +185,7 @@ def analyze_file(
     for sup in sups:
         if sup.reason:  # reasonless suppressions suppress nothing
             by_line.setdefault(sup.target_line, []).append(sup)
+    used: set[int] = set()
     for f in findings:
         if f.rule == registry.BAD_SUPPRESSION:
             continue
@@ -162,10 +193,64 @@ def analyze_file(
             if f.rule in sup.rules:
                 f.suppressed = True
                 f.suppress_reason = sup.reason
+                used.add(id(sup))
                 break
+
+    # unused-suppression: a reasoned disable that silenced nothing. Judged
+    # only when every rule it names ran in this sweep — under --rules
+    # filtering (or for engine pseudo-rule targets) staleness is
+    # undecidable and the suppression is left alone.
+    ran = {r.id for r in rules}
+    stale: list[Finding] = []
+    for sup in sups:
+        if not sup.reason or id(sup) in used or sup.rules - ran:
+            continue
+        stale.append(Finding(
+            rule=registry.UNUSED_SUPPRESSION,
+            path=str(ctx.path),
+            line=sup.comment_line,
+            col=0,
+            message=(
+                f"suppression of {', '.join(sorted(sup.rules))} matched no "
+                "finding — the code was fixed or the rule moved on; delete "
+                "the stale disable comment"
+            ),
+        ))
+    # Stale findings are themselves suppressable (the escape hatch for a
+    # disable kept deliberately, e.g. guarding a platform-specific path).
+    for f in stale:
+        for sup in by_line.get(f.line, ()):
+            if registry.UNUSED_SUPPRESSION in sup.rules:
+                f.suppressed = True
+                f.suppress_reason = sup.reason
+                break
+    findings.extend(stale)
 
     findings.sort(key=sort_key)
     return findings
+
+
+def analyze_file(
+    path: str | Path,
+    rules: Sequence[registry.Rule] | None = None,
+    config: Config | None = None,
+    source: str | None = None,
+) -> list[Finding]:
+    """All findings for one file, suppression state applied. The file gets
+    a degenerate one-file sweep: cross-module rules see only it (a direct
+    forbidden import still fires; transitive ones need the full sweep)."""
+    path = Path(path)
+    config = config or Config()
+    if rules is None:
+        rules = list(registry.all_rules().values())
+    if source is None:
+        source = path.read_text(encoding="utf-8")
+
+    ctx = _parse(path, source, config)
+    if isinstance(ctx, Finding):
+        return [ctx]
+    ctx.sweep = SweepContext(contexts=[ctx], config=config)
+    return _check_context(ctx, rules)
 
 
 def analyze_paths(
@@ -173,11 +258,28 @@ def analyze_paths(
     rules: Sequence[registry.Rule] | None = None,
     config: Config | None = None,
 ) -> tuple[list[Finding], int]:
-    """(findings across all files, number of files checked)."""
+    """(findings across all files, number of files checked) — the
+    two-phase whole-program sweep."""
     files = iter_python_files(paths)
+    config = config or Config()
     if rules is None:
         rules = list(registry.all_rules().values())
+
+    # Phase 1: index. Parse everything; unparseable files report and drop
+    # out of the graph (their imports are unknowable).
     findings: list[Finding] = []
+    contexts: list[FileContext] = []
     for f in files:
-        findings.extend(analyze_file(f, rules=rules, config=config))
+        got = _parse(f, f.read_text(encoding="utf-8"), config)
+        if isinstance(got, Finding):
+            findings.append(got)
+        else:
+            contexts.append(got)
+    sweep = SweepContext(contexts=contexts, config=config)
+    for ctx in contexts:
+        ctx.sweep = sweep
+
+    # Phase 2: rules, per file, against the shared sweep.
+    for ctx in contexts:
+        findings.extend(_check_context(ctx, rules))
     return findings, len(files)

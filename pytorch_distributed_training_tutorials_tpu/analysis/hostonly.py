@@ -1,0 +1,45 @@
+"""The single source of truth for which modules are host-only (jax-free).
+
+A module listed here promises it can be imported on a machine with no jax
+installed and no backend reachable — the serving stack's schedulers,
+routers, prefix/page indexes, chaos injectors, and post-mortem tooling all
+make that promise (CLAUDE.md serving invariants), because scheduling
+decisions and flight-dump rendering must never initialize XLA.
+
+Two enforcement layers read THIS tuple, so they can never drift:
+
+- the static ``jax-free-host`` graftcheck rule
+  (``analysis/rules/jax_free_host.py``): every listed module must be
+  *transitively* jax-free over the sweep's import graph — a forbidden
+  import two hops down is caught in milliseconds, without running jax;
+- the runtime subprocess pin (``tests/test_prefix.py``): imports every
+  listed module in a fresh interpreter and asserts ``jax`` never lands
+  in ``sys.modules`` — the ground-truth check the static rule
+  approximates.
+
+To declare a new host-only module: add it here. Both layers pick it up;
+nothing else to edit. (This module is itself pure stdlib — the analysis
+package must be incapable of violating the invariants it enforces.)
+"""
+
+from __future__ import annotations
+
+_PKG = "pytorch_distributed_training_tutorials_tpu"
+
+# Dotted module names, importable order (ancestor packages are implied —
+# they are lazy PEP 562 re-exporters and get checked transitively).
+HOST_ONLY_MODULES: tuple[str, ...] = (
+    f"{_PKG}.adapters",
+    f"{_PKG}.adapters.registry",
+    f"{_PKG}.obs.flight",
+    f"{_PKG}.obs.histogram",
+    f"{_PKG}.serve.pages",
+    f"{_PKG}.serve.prefix",
+    f"{_PKG}.serve.router",
+    f"{_PKG}.serve.scheduler",
+    f"{_PKG}.utils.chaos",
+)
+
+# Import roots that mean "this process now owns an XLA backend" (flax and
+# optax drag jax in transitively; jaxlib is the backend itself).
+FORBIDDEN_IMPORT_ROOTS: tuple[str, ...] = ("jax", "jaxlib", "flax", "optax")

@@ -1,0 +1,202 @@
+"""Package-wide import graph over a sweep's file set.
+
+The per-file rules (PR 1) are blind to anything transitive: a host-only
+module that imports a clean-looking sibling which imports jax two hops
+down passes every single-file check. :class:`ModuleGraph` gives rules the
+missing whole-program view — which in-sweep module each file is, what it
+imports (absolute and relative, in-graph and external), and transitive
+reachability queries — built once per sweep from the already-parsed trees
+(pure stdlib, no filesystem reads beyond ``__init__.py`` existence probes
+for package naming).
+
+Only MODULE-LEVEL imports count as edges: a function-local ``import jax``
+does not execute at import time, and the repo's PEP 562 lazy package
+inits (``utils/__init__.py``, ``serve/__init__.py``) are exactly the
+sanctioned pattern for keeping a package importable without its heavy
+submodules — modeling call-time imports would flag the idiom the
+host-only contract is built on. Class bodies DO execute at import time
+and are included; ``if TYPE_CHECKING:`` blocks never execute and are
+skipped.
+
+Importing ``a.b.c`` also executes ``a/__init__.py`` and ``a/b/__init__.py``
+— ancestor packages present in the sweep are edges too (a jax-eager
+package init poisons every submodule import).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable
+
+
+def module_name(path: Path, file_set: frozenset[Path] | None = None) -> str:
+    """Dotted module name for ``path``: walk up while the parent directory
+    is a package (its ``__init__.py`` is in the sweep's file set or on
+    disk). A file outside any package is a top-level module named by its
+    stem (the scripts/ and examples/ case)."""
+    path = Path(path)
+    parts = [] if path.stem == "__init__" else [path.stem]
+    d = path.parent
+    while True:
+        init = d / "__init__.py"
+        if (file_set is not None and init in file_set) or init.exists():
+            parts.insert(0, d.name)
+            d = d.parent
+        else:
+            break
+    return ".".join(parts) if parts else path.stem
+
+
+@dataclass
+class _Module:
+    name: str
+    path: Path
+    # in-graph module name -> line of the first import creating the edge
+    internal: dict[str, int] = field(default_factory=dict)
+    # external top-level root -> line of the first import
+    external: dict[str, int] = field(default_factory=dict)
+
+
+def _is_type_checking_test(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+        isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING"
+    )
+
+
+def _import_time_stmts(body: Iterable[ast.stmt]):
+    """Statements that execute at module import time: the module body,
+    descending into try/if/with blocks and class bodies, never into
+    function bodies, skipping ``if TYPE_CHECKING:``."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, ast.If):
+            if not _is_type_checking_test(stmt.test):
+                yield from _import_time_stmts(stmt.body)
+            yield from _import_time_stmts(stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            yield from _import_time_stmts(stmt.body)
+            for h in stmt.handlers:
+                yield from _import_time_stmts(h.body)
+            yield from _import_time_stmts(stmt.orelse)
+            yield from _import_time_stmts(stmt.finalbody)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            yield from _import_time_stmts(stmt.body)
+        elif isinstance(stmt, ast.ClassDef):
+            yield from _import_time_stmts(stmt.body)
+
+
+class ModuleGraph:
+    """Import graph over ``(path, tree)`` pairs — one node per swept file."""
+
+    def __init__(self, files: Iterable[tuple[Path, ast.AST]]):
+        pairs = [(Path(p), t) for p, t in files]
+        file_set = frozenset(p for p, _ in pairs)
+        self._by_path: dict[Path, _Module] = {}
+        self.modules: dict[str, _Module] = {}
+        for path, tree in pairs:
+            mod = _Module(name=module_name(path, file_set), path=path)
+            self._by_path[path] = mod
+            self.modules[mod.name] = mod
+        for path, tree in pairs:
+            self._collect_edges(self._by_path[path], tree)
+
+    # ------------------------------------------------------------- building
+
+    def _collect_edges(self, mod: _Module, tree: ast.Module) -> None:
+        for stmt in _import_time_stmts(tree.body):
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    self._add_target(mod, alias.name, stmt.lineno)
+            elif isinstance(stmt, ast.ImportFrom):
+                base = self._resolve_from(mod, stmt)
+                if base is None:
+                    continue
+                if base:
+                    self._add_target(mod, base, stmt.lineno)
+                for alias in stmt.names:
+                    if alias.name == "*":
+                        continue
+                    # `from X import n` where X.n is a swept module imports
+                    # that module too (the `from .scheduler import Request`
+                    # idiom); a plain attribute resolves to nothing extra.
+                    sub = f"{base}.{alias.name}" if base else alias.name
+                    if sub in self.modules:
+                        self._add_target(mod, sub, stmt.lineno)
+
+    def _resolve_from(self, mod: _Module, stmt: ast.ImportFrom) -> str | None:
+        """Absolute dotted base of a ``from`` import, or None when a
+        relative import escapes past the sweep's package roots."""
+        if not stmt.level:
+            return stmt.module or ""
+        # __package__ of a module: itself for a package __init__, else the
+        # parent; each extra level drops one more trailing part.
+        pkg_parts = mod.name.split(".")
+        if mod.path.stem != "__init__":
+            pkg_parts = pkg_parts[:-1]
+        drop = stmt.level - 1
+        if drop > len(pkg_parts):
+            return None
+        base_parts = pkg_parts[: len(pkg_parts) - drop]
+        if stmt.module:
+            base_parts = base_parts + stmt.module.split(".")
+        return ".".join(base_parts)
+
+    def _add_target(self, mod: _Module, dotted: str, line: int) -> None:
+        """Record edges for an import of ``dotted``: every in-graph prefix
+        is an internal edge (importing a.b.c executes a and a.b); a target
+        with no in-graph prefix is an external root."""
+        if not dotted:
+            return
+        parts = dotted.split(".")
+        hit = False
+        for i in range(len(parts)):
+            prefix = ".".join(parts[: i + 1])
+            target = self.modules.get(prefix)
+            if target is not None and target is not mod:
+                mod.internal.setdefault(prefix, line)
+                hit = True
+        if not hit:
+            mod.external.setdefault(parts[0], line)
+
+    # -------------------------------------------------------------- queries
+
+    def module_of(self, path: str | Path) -> str | None:
+        m = self._by_path.get(Path(path))
+        return m.name if m else None
+
+    def forbidden_chain(
+        self, name: str, roots: tuple[str, ...]
+    ) -> tuple[list[str], int] | None:
+        """Shortest import chain from ``name`` to a forbidden external root,
+        as ``(["name", ..., "jax"], line)`` where ``line`` is the import in
+        ``name`` that starts the chain — or None when transitively clean."""
+        start = self.modules.get(name)
+        if start is None:
+            return None
+        # BFS over internal edges; parent links reconstruct the chain.
+        parents: dict[str, str | None] = {name: None}
+        queue = [name]
+        while queue:
+            cur = queue.pop(0)
+            mod = self.modules[cur]
+            for root in roots:
+                if root in mod.external:
+                    chain = [root]
+                    node: str | None = cur
+                    while node is not None:
+                        chain.insert(0, node)
+                        node = parents[node]
+                    first_hop = chain[1]
+                    line = (
+                        start.external[first_hop]
+                        if first_hop in start.external
+                        else start.internal[first_hop]
+                    )
+                    return chain, line
+            for nxt in mod.internal:
+                if nxt not in parents:
+                    parents[nxt] = cur
+                    queue.append(nxt)
+        return None

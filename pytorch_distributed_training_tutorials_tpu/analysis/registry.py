@@ -22,7 +22,13 @@ _RULES: dict[str, "Rule"] = {}
 # checked in, and so suppression-comment validation knows the full id set.
 PARSE_ERROR = "parse-error"
 BAD_SUPPRESSION = "bad-suppression"
-ENGINE_RULE_IDS = frozenset({PARSE_ERROR, BAD_SUPPRESSION})
+# A reasoned suppression that silenced zero findings this sweep: either the
+# flagged code was fixed (delete the stale comment) or the rule evolved past
+# it — both mean the inline claim no longer matches reality. Only judged
+# when every rule the suppression names actually ran (a --rules-filtered
+# sweep cannot tell stale from unexercised).
+UNUSED_SUPPRESSION = "unused-suppression"
+ENGINE_RULE_IDS = frozenset({PARSE_ERROR, BAD_SUPPRESSION, UNUSED_SUPPRESSION})
 
 
 class Rule:

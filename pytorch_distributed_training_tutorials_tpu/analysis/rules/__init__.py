@@ -6,8 +6,11 @@ import it below, and the engine/CLI/`--list-rules` pick it up.
 """
 
 from pytorch_distributed_training_tutorials_tpu.analysis.rules import (  # noqa: F401
+    engine_static,
+    fetch_budget,
     host_sync,
     import_purity,
+    jax_free_host,
     naive_timing,
     reference_citation,
     strategy_interface,

@@ -1,0 +1,327 @@
+"""engine-static: per-request data must not become compile-time structure.
+
+Every CLAUDE.md serving section restates the same hazard from a different
+angle: sampling params, slot/bucket geometry, spec k, adapter-bank shape
+and pipeline depth are ENGINE-static; per-request values are DATA. The
+failure mode is always the same — a request attribute reaching something
+the compiler specializes on (an array shape, a ``static_argnums``-bound
+argument, a branch that builds a program) recompiles per request and
+turns the handful-of-compiles-for-the-process-lifetime contract into a
+compile per distinct value.
+
+Heuristic, scoped to files under a ``serve/`` directory. Taint sources,
+per function: parameters annotated ``Request`` (or named ``req`` /
+``request``) and variables assigned from ``*.pop(...)`` /
+``*.pop_request()`` scheduler calls. Taint flows through assignment,
+arithmetic, subscripts, attributes and containers — and deliberately NOT
+through calls, comparisons or boolean ops: a call is the sanctioning
+seam (``bucket_len(p_len, window)`` quantizing a length into the bounded
+pow2 family is exactly the sanctioned idiom), and a comparison yields a
+two-valued bool (a bounded compile family, e.g. the engine's ``grow``
+static). Sinks:
+
+- a tainted value in the shape argument of ``jnp.zeros/ones/empty/full``
+  or any ``.reshape``/``.broadcast_to`` call — per-request shapes;
+- a tainted value bound to a ``static_argnames`` keyword (or a literal
+  ``static_argnums`` position of a plain-function target) of a compiled
+  callable created in the same file via ``X = jax.jit(...)`` /
+  ``self.X = jax.jit(...)`` — per-request statics;
+- a jit/pjit/remat wrapper call under an ``if``/``while`` whose
+  condition mentions tainted data — per-request program CONSTRUCTION
+  (programs are built once at engine init; host branches that merely
+  select among prebuilt programs are the sanctioned design and stay
+  silent).
+
+Zero false positives beats recall (the jitscope posture): unresolvable
+static specs and ``**kwargs`` smuggling are skipped, not guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+from pytorch_distributed_training_tutorials_tpu.analysis.jitscope import (
+    JIT_WRAPPERS,
+    _extract_statics,
+)
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import Rule, register
+
+# jnp constructors whose FIRST positional (or shape=) argument is a shape.
+_SHAPE_CTORS = frozenset({
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.empty", "jax.numpy.full",
+    "numpy.zeros", "numpy.ones", "numpy.empty", "numpy.full",
+})
+# Methods whose arguments are a shape wherever they appear.
+_SHAPE_METHODS = frozenset({"reshape", "broadcast_to"})
+
+_REQUEST_PARAM_NAMES = frozenset({"req", "request"})
+_POP_CALLEES = frozenset({"pop", "pop_request", "_pop_request", "popleft"})
+
+# Expression nodes taint flows THROUGH (any tainted descendant taints the
+# whole expression). Call/Compare/BoolOp are the deliberate stops.
+_FLOW_NODES = (
+    ast.Attribute, ast.Subscript, ast.BinOp, ast.Tuple, ast.List, ast.Set,
+    ast.Dict, ast.Starred, ast.IfExp, ast.JoinedStr, ast.FormattedValue,
+    ast.Slice, ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp,
+    ast.NamedExpr,
+)
+
+
+def _annotation_is_request(node: ast.arg) -> bool:
+    ann = node.annotation
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.split(".")[-1] == "Request"
+    if isinstance(ann, ast.Name):
+        return ann.id == "Request"
+    if isinstance(ann, ast.Attribute):
+        return ann.attr == "Request"
+    return False
+
+
+class _CompiledBindings:
+    """Same-file ``name = jax.jit(target, static_...)`` bindings: maps the
+    bound name (plain or ``self.``-attribute) to its literal static spec.
+    Unknown/non-literal specs record as unusable (skip, don't guess)."""
+
+    def __init__(self, tree: ast.AST, imap):
+        # bound name -> (static_names, static_nums or None, plain_target)
+        self.bindings: dict[str, tuple[frozenset[str], frozenset[int] | None]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            if imap.resolve(call.func) not in JIT_WRAPPERS:
+                continue
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                bound = target.id
+            elif (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                bound = target.attr
+            else:
+                continue
+            nums, names, unknown = _extract_statics(call)
+            if unknown:
+                continue
+            # static_argnums conventions differ between bound methods
+            # (exclude self) and unbound targets (include it) — only trust
+            # positions when the wrapped target is a plain function name.
+            plain = bool(call.args) and isinstance(call.args[0], ast.Name)
+            self.bindings[bound] = (
+                frozenset(names),
+                frozenset(nums) if plain else None,
+            )
+
+    def lookup(self, call: ast.Call):
+        func = call.func
+        if isinstance(func, ast.Name):
+            return func.id, self.bindings.get(func.id)
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"):
+            return f"self.{func.attr}", self.bindings.get(func.attr)
+        return None, None
+
+
+@register
+class EngineStatic(Rule):
+    id = "engine-static"
+    description = (
+        "per-request data (Request attributes, scheduler-popped values) "
+        "must not reach shapes, static_argnums/argnames, or conditional "
+        "program construction in serve/ — the recompile-per-request hazard"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        if "serve" not in ctx.path.parts:
+            return
+        compiled = _CompiledBindings(ctx.tree, ctx.import_map)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, node, compiled)
+
+    # ------------------------------------------------------------- taint
+
+    def _seed_taint(self, fn) -> set[str]:
+        tainted: set[str] = set()
+        a = fn.args
+        for arg in a.posonlyargs + a.args + a.kwonlyargs:
+            if _annotation_is_request(arg) or arg.arg in _REQUEST_PARAM_NAMES:
+                tainted.add(arg.arg)
+        return tainted
+
+    def _propagate(self, fn, tainted: set[str]) -> set[str]:
+        """Fixpoint over the function body's assignments/loops."""
+        # Nested defs get their own per-function pass; exclude their
+        # bodies here (id-set membership — one walk, not quadratic).
+        nested: set[int] = set()
+        for n in ast.walk(fn):
+            if (isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n is not fn):
+                nested.update(id(sub) for sub in ast.walk(n))
+        stmts = [
+            n for n in ast.walk(fn)
+            if id(n) not in nested
+            and isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                               ast.For, ast.AsyncFor))
+        ]
+
+        changed = True
+        while changed:
+            changed = False
+            for node in stmts:
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    value = node.value
+                    if value is None:
+                        continue
+                    hot = self._is_tainted(value, tainted) or (
+                        isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Attribute)
+                        and value.func.attr in _POP_CALLEES
+                    )
+                    if not hot:
+                        continue
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for tgt in targets:
+                        for name in _target_names(tgt):
+                            if name not in tainted:
+                                tainted.add(name)
+                                changed = True
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    if self._is_tainted(node.iter, tainted):
+                        for name in _target_names(node.target):
+                            if name not in tainted:
+                                tainted.add(name)
+                                changed = True
+        return tainted
+
+    def _is_tainted(self, node: ast.AST, tainted: set[str]) -> bool:
+        """Value-taint: does this expression's VALUE derive from request
+        data through flow nodes only (calls/comparisons sanitize)?"""
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, _FLOW_NODES):
+            return any(
+                self._is_tainted(c, tainted)
+                for c in ast.iter_child_nodes(node)
+            )
+        if isinstance(node, ast.UnaryOp):
+            return not isinstance(node.op, ast.Not) and self._is_tainted(
+                node.operand, tainted
+            )
+        if isinstance(node, ast.comprehension):
+            return self._is_tainted(node.iter, tainted)
+        return False
+
+    def _mentions_taint(self, node: ast.AST, tainted: set[str]) -> bool:
+        """Condition-taint: does this expression MENTION request data
+        anywhere (descending into calls and comparisons too)?"""
+        return any(
+            isinstance(n, ast.Name) and n.id in tainted
+            for n in ast.walk(node)
+        )
+
+    # ------------------------------------------------------------- sinks
+
+    def _check_function(self, ctx, fn, compiled) -> Iterator[Finding]:
+        tainted = self._seed_taint(fn)
+        tainted = self._propagate(fn, tainted)
+        if not tainted:
+            # No request data in scope — but still scan for pop-assigned
+            # sources discovered during propagation above (handled there).
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                yield from self._check_shape_sink(ctx, node, tainted)
+                yield from self._check_static_sink(
+                    ctx, node, tainted, compiled
+                )
+            elif isinstance(node, (ast.If, ast.While)):
+                yield from self._check_construction_sink(ctx, node, tainted)
+
+    def _check_shape_sink(self, ctx, call, tainted) -> Iterator[Finding]:
+        path = ctx.import_map.resolve(call.func)
+        shape_args: list[ast.AST] = []
+        label = None
+        if path in _SHAPE_CTORS:
+            label = path
+            if call.args:
+                shape_args.append(call.args[0])
+            shape_args.extend(
+                kw.value for kw in call.keywords if kw.arg == "shape"
+            )
+        elif (path is None and isinstance(call.func, ast.Attribute)
+                and call.func.attr in _SHAPE_METHODS):
+            label = f".{call.func.attr}()"
+            shape_args.extend(call.args)
+            shape_args.extend(
+                kw.value for kw in call.keywords if kw.arg == "shape"
+            )
+        for arg in shape_args:
+            if self._is_tainted(arg, tainted):
+                yield self.finding(
+                    ctx, call,
+                    f"per-request value reaches the shape argument of "
+                    f"{label}; shapes compile — bucket the value "
+                    "(bucket_len) or size by engine-static geometry",
+                )
+                return
+
+    def _check_static_sink(self, ctx, call, tainted, compiled
+                           ) -> Iterator[Finding]:
+        bound, spec = compiled.lookup(call)
+        if spec is None:
+            return
+        static_names, static_nums = spec
+        for kw in call.keywords:
+            if kw.arg in static_names and self._is_tainted(kw.value, tainted):
+                yield self.finding(
+                    ctx, call,
+                    f"per-request value bound to static arg {kw.arg!r} of "
+                    f"compiled {bound}; statics recompile per distinct "
+                    "value — pass only bucketed/engine-static values",
+                )
+                return
+        if static_nums:
+            for i, arg in enumerate(call.args):
+                if i in static_nums and self._is_tainted(arg, tainted):
+                    yield self.finding(
+                        ctx, call,
+                        f"per-request value at static position {i} of "
+                        f"compiled {bound}; statics recompile per distinct "
+                        "value — pass only bucketed/engine-static values",
+                    )
+                    return
+
+    def _check_construction_sink(self, ctx, node, tainted
+                                 ) -> Iterator[Finding]:
+        if not self._mentions_taint(node.test, tainted):
+            return
+        for branch in (node.body, node.orelse):
+            for stmt in branch:
+                for sub in ast.walk(stmt):
+                    if (isinstance(sub, ast.Call)
+                            and ctx.import_map.resolve(sub.func)
+                            in JIT_WRAPPERS):
+                        yield self.finding(
+                            ctx, sub,
+                            "compiled-program construction under a "
+                            "per-request condition; programs are built "
+                            "once at engine init and selected from a "
+                            "bounded family — never compiled per request",
+                        )
+                        return
+
+
+def _target_names(node: ast.AST) -> Iterator[str]:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            yield n.id

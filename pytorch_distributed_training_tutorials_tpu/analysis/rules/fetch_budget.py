@@ -1,0 +1,97 @@
+"""fetch-budget: serve/ host syncs only at the budgeted call sites.
+
+THE serving invariant (CLAUDE.md): the fetch budget is exactly chains +
+prefills + splices — one batched ``jax.device_get`` per decode chain in
+``_collect_chain``, one scalar fetch per prefill/splice in ``_refill`` /
+``_refill_paged`` / ``_advance_one``. Every other host sync in the
+request loop is a stall the ~75-130 ms per-launch roundtrip multiplies:
+a stray ``.item()`` in a sweep or a ``device_get`` in a stats method
+silently turns a launch-amortized engine back into per-token traffic.
+The runtime budget is pinned by monkeypatching ``jax.device_get``
+(tests/test_serve.py) — twenty minutes into tier-1; this rule fails the
+same edit half a second into the lint sweep.
+
+Scope: files under a ``serve/`` directory, except ``__main__.py`` — the
+selftest harness IS the budget's measuring instrument (its reference
+decodes, fetch-counting spies, and receipt assembly all fetch
+deliberately, outside the request loop). A sync anywhere else in serve/
+must either move inside a budgeted function or carry a reasoned inline
+disable saying which budget line it adds.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import Rule, register
+
+# The budgeted call sites, by enclosing function: the _collect-family
+# chain fetch and the prefill/splice scalar fetches. Growing the budget
+# is an engine-contract change — extend this set in the same PR that
+# updates the CLAUDE.md budget line and the monkeypatch spies.
+BUDGETED_FUNCTIONS = frozenset({
+    "_collect_chain",   # ONE batched device_get per decode chain
+    "_refill",          # one scalar first-token fetch per prefill/splice
+    "_refill_paged",    # the paged twin
+    "_advance_one",     # chunked prefill's final-chunk scalar fetch
+})
+
+# Dotted call paths that force a device->host transfer or blocking wait.
+SYNC_PATHS = frozenset({
+    "jax.device_get",
+    "jax.block_until_ready",
+    "numpy.asarray",
+    "numpy.array",
+})
+
+# Method names that sync regardless of receiver spelling (a jax array's
+# `.item()` / `.block_until_ready()` — unresolvable statically).
+SYNC_METHODS = frozenset({"item", "block_until_ready"})
+
+
+@register
+class FetchBudget(Rule):
+    id = "fetch-budget"
+    description = (
+        "host syncs in serve/ (device_get / .item() / np.asarray / "
+        "block_until_ready) only inside the budgeted call sites — the "
+        "budget is exactly chains + prefills + splices"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        if "serve" not in ctx.path.parts or ctx.path.name == "__main__.py":
+            return
+        yield from self._walk(ctx, ctx.tree, budgeted=False)
+
+    def _walk(self, ctx, node: ast.AST, budgeted: bool) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._walk(
+                    ctx, child,
+                    budgeted or child.name in BUDGETED_FUNCTIONS,
+                )
+                continue
+            if isinstance(child, ast.Call) and not budgeted:
+                hit = self._sync_name(ctx, child)
+                if hit is not None:
+                    yield self.finding(
+                        ctx, child,
+                        f"{hit} outside the budgeted call sites "
+                        f"({', '.join(sorted(BUDGETED_FUNCTIONS))}); the "
+                        "serve/ fetch budget is exactly chains + prefills "
+                        "+ splices — batch the value into an existing "
+                        "budgeted fetch or keep it on device",
+                    )
+            yield from self._walk(ctx, child, budgeted)
+
+    def _sync_name(self, ctx, call: ast.Call) -> str | None:
+        path = ctx.import_map.resolve(call.func)
+        if path in SYNC_PATHS:
+            return path
+        if (path is None and isinstance(call.func, ast.Attribute)
+                and call.func.attr in SYNC_METHODS
+                and not call.args and not call.keywords):
+            return f".{call.func.attr}()"
+        return None

@@ -1,0 +1,61 @@
+"""jax-free-host: declared host-only modules are *transitively* jax-free.
+
+The serving stack's host-only contract (CLAUDE.md serving invariants):
+schedulers, routers, prefix/page indexes, chaos injectors, and flight
+tooling must import cleanly on a jax-less machine — a scheduling decision
+that initializes XLA breaks every multi-process world and every laptop
+post-mortem. The runtime subprocess pin (tests/test_prefix.py) proves it
+by importing each module in a fresh interpreter; this rule proves the
+same property statically, in milliseconds, over the sweep's import graph
+(:mod:`..modgraph`) — including the case no single-file rule can see: a
+forbidden import two hops down a chain of clean-looking siblings.
+
+Both layers read the SAME declaration (:mod:`..hostonly`), so the static
+and runtime checks can never drift. Only module-level imports count —
+function-local imports and the PEP 562 lazy package-init pattern are the
+sanctioned ways to keep heavy deps out of import time (the runtime pin
+agrees: it only observes import-time effects).
+
+The finding lands on the import line in the declared module that starts
+the offending chain, with the full chain in the message.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from pytorch_distributed_training_tutorials_tpu.analysis.findings import Finding
+from pytorch_distributed_training_tutorials_tpu.analysis.registry import Rule, register
+
+
+@register
+class JaxFreeHost(Rule):
+    id = "jax-free-host"
+    description = (
+        "modules declared host-only (analysis/hostonly.py) must be "
+        "transitively jax-free over the sweep's import graph — the "
+        "static twin of the runtime no-jax subprocess pin"
+    )
+
+    def check(self, ctx) -> Iterator[Finding]:
+        sweep = ctx.sweep
+        declared = ctx.config.host_only_modules
+        if sweep is None or not declared:
+            return
+        graph = sweep.modgraph
+        name = graph.module_of(ctx.path)
+        if name not in declared:
+            return
+        got = graph.forbidden_chain(name, ctx.config.forbidden_import_roots)
+        if got is None:
+            return
+        chain, line = got
+        yield self.finding(
+            ctx, None,
+            f"host-only module {name} transitively imports {chain[-1]} "
+            f"(via {' -> '.join(chain)}); host-only modules must import "
+            "cleanly without a backend — make the import lazy "
+            "(function-local / PEP 562) or undeclare the module in "
+            "analysis/hostonly.py",
+            line=line,
+        )

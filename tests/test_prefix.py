@@ -219,29 +219,22 @@ def test_segment_repr_is_cheap():
 
 
 def test_prefix_module_imports_no_jax():
-    """serve/prefix.py is host-only by contract (CLAUDE.md serving
+    """The runtime half of the host-only contract (CLAUDE.md serving
     invariants): scheduling/index decisions must never initialize a
-    backend. Same subprocess discipline as the scheduler's pin."""
+    backend. The module list is SINGLE-SOURCED from
+    analysis/hostonly.py — the same declaration graftcheck's
+    jax-free-host rule enforces statically over the import graph, so the
+    runtime pin and the static rule can never drift. (The import is
+    jax-free itself: analysis/ is pure stdlib.)"""
+    from pytorch_distributed_training_tutorials_tpu.analysis.hostonly import (
+        HOST_ONLY_MODULES,
+    )
+
     code = (
         "import sys\n"
-        "import pytorch_distributed_training_tutorials_tpu.serve.prefix\n"
-        "import pytorch_distributed_training_tutorials_tpu.serve.scheduler\n"
-        # the adapter registry (tenant name -> bank row) and the lazy
-        # adapters package itself share the host-only contract (ISSUE 8)
-        "import pytorch_distributed_training_tutorials_tpu.adapters.registry\n"
-        "import pytorch_distributed_training_tutorials_tpu.adapters\n"
-        # the flight recorder + histograms (ISSUE 10) are post-mortem
-        # tooling that must run on jax-less laptops over scp'd dumps
-        "import pytorch_distributed_training_tutorials_tpu.obs.flight\n"
-        "import pytorch_distributed_training_tutorials_tpu.obs.histogram\n"
-        # the fleet router + its chaos injectors (ISSUE 12) are pure
-        # host routing decisions — same contract as the scheduler
-        "import pytorch_distributed_training_tutorials_tpu.serve.router\n"
-        "import pytorch_distributed_training_tutorials_tpu.utils.chaos\n"
-        # the page-pool allocator (ISSUE 13) is host bookkeeping over
-        # page ids — refcounts and free lists never touch the device
-        "import pytorch_distributed_training_tutorials_tpu.serve.pages\n"
-        "assert 'jax' not in sys.modules, 'prefix index must not import jax'\n"
+        + "".join(f"import {m}\n" for m in HOST_ONLY_MODULES)
+        + "assert 'jax' not in sys.modules, "
+          "'host-only modules must not import jax'\n"
     )
     env = {k: v for k, v in os.environ.items() if k != "PYTHONSTARTUP"}
     out = subprocess.run(

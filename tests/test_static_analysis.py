@@ -803,6 +803,424 @@ def test_cli_exit_codes_and_json(tmp_path, capsys):
         assert rid in listing
 
 
+# ----------------------------------------------------------------- jax-free-host
+
+def _host_pkg(tmp_path, helper_src: str):
+    """A tmp package: pkg/sub/hostmod.py -> pkg/sub/helper.py -> ???"""
+    root = tmp_path / "pkg"
+    (root / "sub").mkdir(parents=True)
+    (root / "__init__.py").write_text("import importlib\n")
+    (root / "sub" / "__init__.py").write_text("import importlib\n")
+    (root / "sub" / "hostmod.py").write_text("from pkg.sub import helper\n")
+    (root / "sub" / "helper.py").write_text(helper_src)
+    return root
+
+
+HOST_CFG = Config(host_only_modules=("pkg.sub.hostmod",),
+                  forbidden_import_roots=("jax", "flax"))
+
+
+def test_jax_free_host_fires_on_transitive_import(tmp_path):
+    """THE case no single-file rule can see: hostmod.py itself never
+    mentions jax — the violation is two hops down the import graph."""
+    root = _host_pkg(tmp_path, "from pkg.sub import deep\n")
+    (root / "sub" / "deep.py").write_text("import os\nimport jax\n")
+    findings, _ = analyze_paths([root], config=HOST_CFG)
+    found = hits(findings, "jax-free-host")
+    assert len(found) == 1
+    f = found[0]
+    assert f.path.endswith("hostmod.py") and f.line == 1
+    assert "pkg.sub.hostmod -> pkg.sub.helper -> pkg.sub.deep -> jax" \
+        in f.message
+
+
+def test_jax_free_host_clean_chain_is_silent(tmp_path):
+    root = _host_pkg(tmp_path, "import os\nimport collections\n")
+    findings, _ = analyze_paths([root], config=HOST_CFG)
+    assert not hits(findings, "jax-free-host")
+
+
+def test_jax_free_host_function_local_import_is_the_sanctioned_pattern(
+        tmp_path):
+    # lazy import inside a function never runs at import time — the
+    # runtime subprocess pin agrees (it only observes import-time effects)
+    root = _host_pkg(
+        tmp_path,
+        "def heavy():\n    import jax\n    return jax\n",
+    )
+    findings, _ = analyze_paths([root], config=HOST_CFG)
+    assert not hits(findings, "jax-free-host")
+
+
+def test_jax_free_host_undeclared_module_may_import_jax(tmp_path):
+    root = _host_pkg(tmp_path, "import jax\n")
+    cfg = Config(host_only_modules=("pkg.sub.other",),
+                 forbidden_import_roots=("jax",))
+    findings, _ = analyze_paths([root], config=cfg)
+    assert not hits(findings, "jax-free-host")
+
+
+def test_jax_free_host_direct_import_fires_in_single_file_analysis():
+    # the degenerate one-file sweep still catches a DIRECT violation
+    cfg = Config(host_only_modules=("hostmod",),
+                 forbidden_import_roots=("jax",))
+    found = hits(check("import os\nimport jax\n", path="fixture/hostmod.py",
+                       config=cfg), "jax-free-host")
+    assert len(found) == 1 and found[0].line == 2
+
+
+def test_jax_free_host_suppressible_with_reason(tmp_path):
+    root = _host_pkg(tmp_path, "import jax\n")
+    (root / "sub" / "hostmod.py").write_text(
+        "# graftcheck: disable=jax-free-host -- fixture: deliberately dirty\n"
+        "from pkg.sub import helper\n"
+    )
+    findings, _ = analyze_paths([root], config=HOST_CFG)
+    assert not hits(findings, "jax-free-host")
+    assert any(f.rule == "jax-free-host" and f.suppressed for f in findings)
+
+
+def test_host_only_declaration_matches_the_swept_tree():
+    """Single-source assertion: every declared host-only module exists in
+    the repo sweep's import graph, and the static rule + the runtime
+    subprocess pin (test_prefix.py) read the SAME constant — the
+    declaration cannot rot silently in either direction."""
+    from pytorch_distributed_training_tutorials_tpu.analysis.engine import (
+        SweepContext, _parse,
+    )
+    from pytorch_distributed_training_tutorials_tpu.analysis.hostonly import (
+        FORBIDDEN_IMPORT_ROOTS, HOST_ONLY_MODULES,
+    )
+
+    assert Config().host_only_modules == HOST_ONLY_MODULES
+    assert Config().forbidden_import_roots == FORBIDDEN_IMPORT_ROOTS
+
+    cfg = Config()
+    contexts = []
+    for p in sorted(PKG.rglob("*.py")):
+        got = _parse(p, p.read_text(encoding="utf-8"), cfg)
+        if hasattr(got, "tree"):  # FileContext, not a parse-error Finding
+            contexts.append(got)
+    graph = SweepContext(contexts=contexts, config=cfg).modgraph
+    known = {graph.module_of(c.path) for c in contexts}
+    missing = set(HOST_ONLY_MODULES) - known
+    assert not missing, f"declared host-only but not in tree: {missing}"
+
+
+# ------------------------------------------------------------------ fetch-budget
+
+def test_fetch_budget_fires_on_stray_sync_in_serve():
+    src = """
+        import jax
+        import numpy as np
+
+        def _sweep(self):
+            flags = jax.device_get(self.flags)
+            arr = np.asarray(self.block)
+            n = self.count.item()
+            jax.block_until_ready(self.state)
+            return flags, arr, n
+    """
+    found = hits(check(src, path="serve/engine.py"), "fetch-budget")
+    assert [f.line for f in found] == [6, 7, 8, 9]
+    assert "chains + prefills + splices" in found[0].message
+
+
+def test_fetch_budget_budgeted_sites_are_clean():
+    # the budgeted-vs-stray pair: the SAME calls inside the budget's
+    # enclosing functions (incl. nested helpers) are the contract itself
+    src = """
+        import jax
+
+        def _collect_chain(self):
+            block = jax.device_get(self.block)
+            def distribute(rows):
+                return jax.device_get(rows)
+            return distribute(block)
+
+        def _refill(self, slot):
+            return int(jax.device_get(self.first))
+
+        def _refill_paged(self, slot):
+            return int(jax.device_get(self.first))
+
+        def _advance_one(self):
+            return int(jax.device_get(self.tok))
+    """
+    assert not hits(check(src, path="serve/engine.py"), "fetch-budget")
+
+
+def test_fetch_budget_only_applies_to_serve():
+    src = """
+        import jax
+
+        def flush(self):
+            return jax.device_get(self.losses)
+    """
+    assert not hits(check(src, path="obs/metrics.py"), "fetch-budget")
+
+
+def test_fetch_budget_exempts_the_selftest_harness():
+    # serve/__main__.py IS the measuring instrument: its reference
+    # decodes and fetch-counting spies fetch deliberately
+    src = """
+        import jax
+
+        def selftest():
+            return jax.device_get(make_ref())
+    """
+    assert not hits(check(src, path="serve/__main__.py"), "fetch-budget")
+
+
+def test_fetch_budget_item_with_args_is_not_a_sync():
+    # dict.item-style calls with arguments are not the jax .item() sync
+    src = """
+        import jax
+
+        def lookup(self, k):
+            return self.table.item(k)
+    """
+    assert not hits(check(src, path="serve/engine.py"), "fetch-budget")
+
+
+def test_fetch_budget_suppressible_with_reason():
+    src = """
+        import jax
+
+        def _probe(self):
+            return jax.device_get(self.x)  # graftcheck: disable=fetch-budget -- debug probe, never in the request loop
+    """
+    findings = check(src, path="serve/engine.py")
+    assert not hits(findings, "fetch-budget")
+    assert any(f.rule == "fetch-budget" and f.suppressed for f in findings)
+
+
+# ----------------------------------------------------------------- engine-static
+
+def test_engine_static_fires_on_request_shape():
+    src = """
+        import jax.numpy as jnp
+
+        def _refill(self, req):
+            return jnp.zeros((req.max_new_tokens,))
+    """
+    found = hits(check(src, path="serve/engine.py"), "engine-static")
+    assert len(found) == 1 and found[0].line == 5
+    assert "shape" in found[0].message
+
+
+def test_engine_static_fires_on_request_static_arg():
+    src = """
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._splice = jax.jit(
+                    self._splice_fn, static_argnames=("seg_len", "grow"))
+
+            def _refill(self, req):
+                return self._splice(req.prompt, seg_len=req.p_len)
+    """
+    found = hits(check(src, path="serve/engine.py"), "engine-static")
+    assert len(found) == 1
+    assert "'seg_len'" in found[0].message
+
+
+def test_engine_static_fires_on_conditional_program_construction():
+    src = """
+        import jax
+
+        def _handle(self, req):
+            if req.p_len > 512:
+                fn = jax.jit(lambda x: x * 2)
+            else:
+                fn = self._default
+            return fn
+    """
+    found = hits(check(src, path="serve/engine.py"), "engine-static")
+    assert len(found) == 1
+    assert "built once at engine init" in found[0].message
+
+
+def test_engine_static_fires_on_scheduler_popped_values():
+    src = """
+        import jax.numpy as jnp
+
+        def _refill_slot(self, slot):
+            item = self.scheduler.pop(self.free)
+            return jnp.zeros((item.p_len,))
+    """
+    assert hits(check(src, path="serve/engine.py"), "engine-static")
+
+
+def test_engine_static_bucketed_values_are_the_sanctioned_idiom():
+    # the REAL engine's shape: bucket_len() quantizes the per-request
+    # length into the bounded pow2 family (a call sanitizes), and a
+    # comparison yields a two-valued bool (bounded compile family) —
+    # both must stay silent, or the rule flags serve/engine.py itself
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        class Engine:
+            def __init__(self):
+                self._splice = jax.jit(
+                    self._splice_fn, static_argnames=("seg_len", "grow"))
+
+            def _refill(self, req):
+                p_len = len(req.prompt)
+                bucket = bucket_len(p_len, self.window)
+                grow = self.prefix is not None and req.key not in self.prefix
+                buf = jnp.zeros((bucket,))
+                return self._splice(buf, seg_len=bucket, grow=grow)
+    """
+    assert not hits(check(src, path="serve/engine.py"), "engine-static")
+
+
+def test_engine_static_host_branch_selecting_prebuilt_programs_is_fine():
+    # branching ON request data to SELECT among prebuilt programs is the
+    # sanctioned design (prefill-vs-splice dispatch); only construction
+    # under the branch fires
+    src = """
+        import jax
+
+        def _refill(self, req):
+            if req.cached:
+                out = self._splice(req.prompt)
+            else:
+                out = self._prefill(req.prompt)
+            return out
+    """
+    assert not hits(check(src, path="serve/engine.py"), "engine-static")
+
+
+def test_engine_static_only_applies_to_serve():
+    src = """
+        import jax.numpy as jnp
+
+        def pad(req):
+            return jnp.zeros((req.n,))
+    """
+    assert not hits(check(src, path="data/loader.py"), "engine-static")
+
+
+def test_engine_static_suppressible_with_reason():
+    src = """
+        import jax.numpy as jnp
+
+        def _refill(self, req):
+            return jnp.zeros((req.n,))  # graftcheck: disable=engine-static -- fixture: bounded by admission check
+    """
+    findings = check(src, path="serve/engine.py")
+    assert not hits(findings, "engine-static")
+    assert any(f.rule == "engine-static" and f.suppressed for f in findings)
+
+
+def test_engine_static_real_engine_is_clean():
+    """The real serve/engine.py — with its seg_len=bucket static, grow
+    BoolOp, and prefill-vs-splice dispatch — must sweep clean; any false
+    positive here means the heuristic's sanitizers regressed."""
+    findings = analyze_file(PKG / "serve" / "engine.py")
+    assert not hits(findings, "engine-static")
+    assert not hits(findings, "fetch-budget")
+
+
+# ----------------------------------------------------------- unused-suppression
+
+def test_unused_suppression_fires_on_stale_disable():
+    src = """
+        import time
+
+        # graftcheck: disable=import-purity -- was needed before the fix
+        x = 1
+    """
+    found = hits(check(src), "unused-suppression")
+    assert len(found) == 1 and found[0].line == 4
+    assert "matched no finding" in found[0].message
+
+
+def test_unused_suppression_silent_when_the_disable_works():
+    findings = check(SUPPRESSED)
+    assert not hits(findings, "unused-suppression")
+
+
+def test_unused_suppression_not_judged_under_rule_filtering():
+    # a --rules-filtered run cannot tell stale from unexercised
+    from pytorch_distributed_training_tutorials_tpu.analysis.registry import select_rules
+
+    src = """
+        import time
+
+        # graftcheck: disable=import-purity -- judged only on full sweeps
+        x = 1
+    """
+    rules = list(select_rules(["naive-timing"]))
+    findings = analyze_file(Path("fixture/mod.py"), rules=rules,
+                            source=textwrap.dedent(src))
+    assert not hits(findings, "unused-suppression")
+
+
+def test_unused_suppression_skips_engine_pseudo_rule_targets():
+    # disable=parse-error etc. guard conditions no Rule ever "runs"
+    src = """
+        # graftcheck: disable=parse-error -- checked-in fixture marker
+        x = 1
+    """
+    assert not hits(check(src), "unused-suppression")
+
+
+def test_unused_suppression_reasonless_disable_is_bad_not_stale():
+    src = """
+        # graftcheck: disable=import-purity
+        x = 1
+    """
+    findings = check(src)
+    assert hits(findings, "bad-suppression")
+    assert not hits(findings, "unused-suppression")
+
+
+def test_unused_suppression_is_itself_suppressible():
+    # the escape hatch: a disable kept deliberately (platform-specific
+    # path the sweep machine never exercises)
+    src = """
+        import time
+
+        # graftcheck: disable=import-purity,unused-suppression -- fires only on the TPU host's sitecustomize
+        x = 1
+    """
+    findings = check(src)
+    assert not hits(findings, "unused-suppression")
+
+
+# ----------------------------------------------------- CLI v2: envelope + --rules
+
+def test_cli_rules_flag_and_versioned_envelope(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.numpy as jnp\nA = jnp.zeros((2,))\n")
+
+    # --rules is the v2 spelling; --select keeps working (tested above)
+    assert cli_main([str(bad), "--rules", "traced-control-flow"]) == 0
+    capsys.readouterr()
+
+    assert cli_main([str(bad), "--json"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["schema"] == "graftcheck-report/v1"
+    assert report["files"] == 1
+    assert report["rule_counts"] == {"import-purity": 1}
+    assert isinstance(report["elapsed_s"], float)
+    assert set(report["rules"]) == set(all_rules())
+
+
+def test_cli_rules_filter_reflected_in_envelope(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.numpy as jnp\nA = jnp.zeros((2,))\n")
+    assert cli_main([str(bad), "--json", "--rules",
+                     "import-purity,naive-timing"]) == 1
+    report = json.loads(capsys.readouterr().out)
+    assert report["rules"] == ["import-purity", "naive-timing"]
+    assert report["rule_counts"] == {"import-purity": 1}
+
+
 # ------------------------------------------------------------- the tier-1 sweep
 
 def test_repo_sweep_has_zero_unsuppressed_findings():
