@@ -121,6 +121,14 @@ CONFIG_FIELDS = (
     # pages_shares, pages_sheds, hbm_high_water_bytes) stay out
     # deliberately — outcomes of the traffic, not configuration
     "paged", "page_size", "pool_pages",
+    # fused paged attention + quantized KV (ISSUE 17): the page-walk
+    # kernel vs the jnp.take gather read path and the KV storage width
+    # (0 = full precision, 8 = int8 + f32 scales, 4 = packed nibbles +
+    # bf16 scales) each change what a tok/s or HBM number MEANS, so
+    # int4/kernel rounds never gate — or get gated by — int8/gather
+    # ones; page_bytes stays out (derived from geometry + kv_bits, not
+    # an independent knob)
+    "kv_bits", "paged_kernel",
     # sharded serving (ISSUE 15): "tp" above already fingerprints the
     # TP width (the int8 decode receipts have carried it since r04);
     # mesh_shape additionally separates mesh GEOMETRIES at equal tp

@@ -93,8 +93,14 @@ class TransformerConfig:
     # the f32-cache reference at near-ties (both attention matmuls still
     # accumulate f32 — masked_attention sets preferred_element_type on
     # the scores AND the context einsum — so the only loss is the storage
-    # rounding itself; int8 rounds harder than bf16).
-    kv_cache_dtype: "jnp.dtype | None" = None
+    # rounding itself; int8 rounds harder than bf16). The string "int4"
+    # selects packed-nibble storage (two int4 values per uint8 byte along
+    # head_dim, per-token-per-head absmax scales stored bfloat16 —
+    # ops.quant.quantize_kv_int4): EXACTLY half the int8 cache bytes per
+    # token-head (D/2 + 2 vs D + 4), the 2x-pages-per-pool claim. dtype
+    # strings ("int8"/"bf16"/...) normalize through _kv_quant_mode /
+    # jnp.dtype, so "int8" and jnp.int8 are the same config.
+    kv_cache_dtype: "jnp.dtype | str | None" = None
     # Paged KV decode (serve/pages.py, ISSUE 13): > 0 restructures the
     # DECODE cache as one shared (kv_pages, kv_page_size, heads, head_dim)
     # pool per layer plus a per-row int32 page-table vector riding the
@@ -109,6 +115,17 @@ class TransformerConfig:
     # programs and cache trees byte-identical to a pre-paging build.
     kv_pages: int = 0
     kv_page_size: int = 0
+    # Fused paged-attention kernel (ops/paged_attention.py, ISSUE 17):
+    # True makes the paged decode branch compute attention straight off
+    # the page pools via the Pallas online-softmax kernel — the page
+    # table is a scalar-prefetch operand steering BlockSpec index_maps,
+    # so no dense (B, max_seq_len, ...) gathered window is ever
+    # materialized (the jnp.take gather path remains the numerics
+    # reference and the False default). ENGINE-STATIC by construction:
+    # a config bool read at trace time, never a traced value (graftcheck
+    # traced-control-flow pins the anti-pattern). Decode-only, like
+    # kv_pages itself; requires kv_pages > 0 to have any effect.
+    paged_kernel: bool = False
     # Tensor-parallel int8 serving: a mesh with a 'model' axis routes every
     # quantized matmul through the shard_map-wrapped kernel
     # (ops.quant.int8_matmul_tp) in the Megatron column/row layout; q/scale
@@ -259,6 +276,77 @@ def _dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
+def _kv_quant_mode(dtype) -> str | None:
+    """Storage-quantization family of a ``kv_cache_dtype`` value:
+    ``"int8"`` (per-token-per-head absmax, f32 scales — ``_quantize_kv``),
+    ``"int4"`` (the packed-nibble sentinel STRING — uint8 storage at
+    head_dim/2 with bfloat16 scales, ``ops.quant.quantize_kv_int4``), or
+    ``None`` for exact storage (f32/bf16/follow-compute). Non-sentinel
+    dtype strings normalize through ``jnp.dtype`` so ``"int8"`` and
+    ``jnp.int8`` configure the same cache."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype == "int4":
+            return "int4"
+        dtype = jnp.dtype(dtype)
+    return "int8" if dtype == jnp.int8 else None
+
+
+def _encode_kv(x: jax.Array, quant: str | None):
+    """Storage-encode one K/V chunk for its cache's quant family —
+    ``(stored, scale)`` with ``scale=None`` for exact storage. The one
+    dispatch shared by the decode/prefill/paged write sites (``quant`` is
+    trace-time static, from the config — never traced data)."""
+    if quant == "int8":
+        return _quantize_kv(x)
+    if quant == "int4":
+        from pytorch_distributed_training_tutorials_tpu.ops.quant import (
+            quantize_kv_int4,
+        )
+
+        return quantize_kv_int4(x)
+    return x, None
+
+
+def _decode_kv(stored: jax.Array, scale, quant: str | None, dtype):
+    """Inverse of :func:`_encode_kv` for the dense read paths (the Pallas
+    paged kernel dequantizes per page tile in VMEM instead — this is its
+    numerics reference). Exact storage returns the stored array as-is
+    (the attention einsums promote it, preserving the pre-int4 lowering
+    bit for bit)."""
+    if quant == "int8":
+        return _dequantize_kv(stored, scale, dtype)
+    if quant == "int4":
+        from pytorch_distributed_training_tutorials_tpu.ops.quant import (
+            dequantize_kv_int4,
+        )
+
+        return dequantize_kv_int4(stored, scale, dtype)
+    return stored
+
+
+def _kv_storage(k_dtype, v_dtype, d: int):
+    """Resolve a (possibly quantized, possibly string) cache dtype pair
+    into concrete storage: ``(k_dtype, v_dtype, stored_head_dim,
+    scale_dtype)`` with ``scale_dtype=None`` for exact storage. int4
+    packs two values per uint8 byte along head_dim (``d // 2`` stored —
+    ops.quant.pack_int4's half-split layout) and keeps bf16 scales so a
+    token-head costs exactly half its int8 twin."""
+    quant = _kv_quant_mode(k_dtype)
+    if quant == "int8":
+        return jnp.int8, jnp.int8, d, jnp.float32
+    if quant == "int4":
+        if d % 2:
+            raise ValueError(f"int4 KV needs an even head_dim, got {d}")
+        return jnp.uint8, jnp.uint8, d // 2, jnp.bfloat16
+    if isinstance(k_dtype, str):
+        k_dtype = jnp.dtype(k_dtype)
+    if isinstance(v_dtype, str):
+        v_dtype = jnp.dtype(v_dtype)
+    return k_dtype, v_dtype, d, None
+
+
 def _store_decode_kv(var, val: jax.Array, pos: jax.Array) -> None:
     """Write one decode chunk's per-row value ``val`` (B, S, ...) into cache
     variable ``var`` (B, max_seq_len, ...) at sequence positions
@@ -287,17 +375,24 @@ def _store_decode_kv(var, val: jax.Array, pos: jax.Array) -> None:
 
 
 def _gather_pages(pool: jax.Array, table: jax.Array) -> jax.Array:
-    """Materialize each row's logical window from the shared page pool.
+    """Materialize each row's logical window from the shared page pool —
+    the REFERENCE read path (``cfg.paged_kernel=False``), and the
+    numerics oracle the fused kernel pins against.
 
     ``pool`` is ``(kv_pages, page_size, ...)``; ``table`` is the per-row
     page-table ``(B, P)`` of int32 page ids (``P * page_size`` = the
     logical window). Returns ``(B, P * page_size, ...)`` — exactly the
-    array the whole-slot decode path reads, which is why paged attention
-    is bitwise the unpaged one: the gather feeds the SAME
-    grouped_masked_attention over the SAME validity mask, and unbacked
-    entries (the sentinel id ``kv_pages``, out of range) fill with 0.0,
-    which the mask already excludes (a masked column contributes an
-    exact softmax zero — see the decode-branch comment below).
+    array the whole-slot decode path reads, which is why THIS path's
+    paged attention is bitwise the unpaged one: the gather feeds the
+    SAME grouped_masked_attention over the SAME validity mask, and
+    unbacked entries (the sentinel id ``kv_pages``, out of range) fill
+    with 0.0, which the mask already excludes (a masked column
+    contributes an exact softmax zero — see the decode-branch comment
+    below). With ``cfg.paged_kernel=True`` this dense window is never
+    built: ``ops.paged_attention`` streams page tiles through an
+    online-softmax accumulator instead, trading the bitwise-to-unpaged
+    guarantee for float-tolerance (greedy token-exact) equivalence and
+    no ``(B, W, ...)`` temporary.
 
     Page ids are traced DATA: ``jnp.take`` with ``mode="fill"``, never a
     Python branch (graftcheck ``traced-control-flow`` has the fixture
@@ -469,27 +564,30 @@ class Attention(nn.Module):
         h, d = cfg.kv_heads, cfg.head_dim
         if cfg.kv_cache_dtype is not None:
             k_dtype = v_dtype = cfg.kv_cache_dtype
+        k_dtype, v_dtype, d_store, scale_dtype = _kv_storage(
+            k_dtype, v_dtype, d
+        )
         cached_k = self.variable(
             "cache", "cached_key",
-            jnp.zeros, (b, cfg.max_seq_len, h, d), k_dtype,
+            jnp.zeros, (b, cfg.max_seq_len, h, d_store), k_dtype,
         )
         cached_v = self.variable(
             "cache", "cached_value",
-            jnp.zeros, (b, cfg.max_seq_len, h, d), v_dtype,
+            jnp.zeros, (b, cfg.max_seq_len, h, d_store), v_dtype,
         )
         idx = self.variable(
             "cache", "cache_index",
             lambda: jnp.zeros((), jnp.int32),
         )
         k_scale = v_scale = None
-        if k_dtype == jnp.int8:
+        if scale_dtype is not None:
             k_scale = self.variable(
                 "cache", "cached_key_scale",
-                jnp.zeros, (b, cfg.max_seq_len, h), jnp.float32,
+                jnp.zeros, (b, cfg.max_seq_len, h), scale_dtype,
             )
             v_scale = self.variable(
                 "cache", "cached_value_scale",
-                jnp.zeros, (b, cfg.max_seq_len, h), jnp.float32,
+                jnp.zeros, (b, cfg.max_seq_len, h), scale_dtype,
             )
         return cached_k, cached_v, idx, k_scale, v_scale
 
@@ -514,6 +612,9 @@ class Attention(nn.Module):
         h, d = cfg.kv_heads, cfg.head_dim
         if cfg.kv_cache_dtype is not None:
             k_dtype = v_dtype = cfg.kv_cache_dtype
+        k_dtype, v_dtype, d_store, scale_dtype = _kv_storage(
+            k_dtype, v_dtype, d
+        )
         npages, psize = cfg.kv_pages, cfg.kv_page_size
         if psize < 1 or cfg.max_seq_len % psize:
             raise ValueError(
@@ -522,11 +623,11 @@ class Attention(nn.Module):
             )
         cached_k = self.variable(
             "cache", "paged_key",
-            jnp.zeros, (npages, psize, h, d), k_dtype,
+            jnp.zeros, (npages, psize, h, d_store), k_dtype,
         )
         cached_v = self.variable(
             "cache", "paged_value",
-            jnp.zeros, (npages, psize, h, d), v_dtype,
+            jnp.zeros, (npages, psize, h, d_store), v_dtype,
         )
         n_tables = cfg.max_seq_len // psize
         table = self.variable(
@@ -538,14 +639,14 @@ class Attention(nn.Module):
             lambda: jnp.zeros((b,), jnp.int32),
         )
         k_scale = v_scale = None
-        if k_dtype == jnp.int8:
+        if scale_dtype is not None:
             k_scale = self.variable(
                 "cache", "paged_key_scale",
-                jnp.zeros, (npages, psize, h), jnp.float32,
+                jnp.zeros, (npages, psize, h), scale_dtype,
             )
             v_scale = self.variable(
                 "cache", "paged_value_scale",
-                jnp.zeros, (npages, psize, h), jnp.float32,
+                jnp.zeros, (npages, psize, h), scale_dtype,
             )
         return cached_k, cached_v, table, idx, k_scale, v_scale
 
@@ -602,50 +703,64 @@ class Attention(nn.Module):
             ).reshape(v.shape)
 
         if decode and cfg.kv_pages:
-            # paged decode (cfg.kv_pages > 0): identical math to the
-            # unpaged branch below — the page gather materializes the
-            # SAME (B, max_seq_len, kv, d) window the whole-slot cache
-            # stores, then the SAME rope/mask/attention runs over it, so
-            # paged greedy decode is bitwise the unpaged one. Only the
-            # storage moves: K/V land in the shared pool through the
-            # per-row page table (traced data — _store_paged_kv /
-            # _gather_pages document the sentinel/drop safety rules).
+            # paged decode (cfg.kv_pages > 0): K/V land in the shared
+            # pool through the per-row page table (traced data —
+            # _store_paged_kv / _gather_pages document the sentinel/drop
+            # safety rules). Two read paths, selected ENGINE-STATICALLY
+            # by cfg.paged_kernel (a config bool — Python control flow on
+            # trace-time structure, never on a traced value):
+            # - gather (default, the numerics reference): materialize the
+            #   window dense and run the same grouped attention as the
+            #   unpaged branch — bitwise the unpaged decode.
+            # - kernel: ops.paged_attention walks the table inside a
+            #   Pallas online-softmax kernel; no dense window exists,
+            #   float-tolerance (token-exact greedy) vs the gather path.
             b, s = x.shape[0], x.shape[1]
             cached_k, cached_v, table, idx, k_scale, v_scale = (
                 self._paged_cache_vars(b, k_raw.dtype, v.dtype)
             )
+            quant = _kv_quant_mode(cfg.kv_cache_dtype)
             pos = idx.value  # (B,) — paged decode is always slot-indexed
             tbl = table.value
             q = apply_rope(q_raw, cfg.rope_theta, offset=pos)
             k = apply_rope(k_raw, cfg.rope_theta, offset=pos)
-            if k_scale is not None:  # int8 pool: store q + scale
-                k_q, k_s = _quantize_kv(k)
-                v_q, v_s = _quantize_kv(v)
-                _store_paged_kv(cached_k, tbl, k_q, pos)
-                _store_paged_kv(cached_v, tbl, v_q, pos)
+            k_q, k_s = _encode_kv(k, quant)
+            v_q, v_s = _encode_kv(v, quant)
+            _store_paged_kv(cached_k, tbl, k_q, pos)
+            _store_paged_kv(cached_v, tbl, v_q, pos)
+            if quant:
                 _store_paged_kv(k_scale, tbl, k_s, pos)
                 _store_paged_kv(v_scale, tbl, v_s, pos)
-                k_read = _dequantize_kv(
-                    _gather_pages(cached_k.value, tbl),
-                    _gather_pages(k_scale.value, tbl), k.dtype,
+            idx.value = pos + s
+            if cfg.paged_kernel:
+                from pytorch_distributed_training_tutorials_tpu.ops.paged_attention import (  # noqa: E501
+                    paged_attention,
                 )
-                v_read = _dequantize_kv(
-                    _gather_pages(cached_v.value, tbl),
-                    _gather_pages(v_scale.value, tbl), v.dtype,
+
+                out = paged_attention(
+                    q, cached_k.value, cached_v.value, tbl, pos,
+                    k_scale=k_scale.value if quant else None,
+                    v_scale=v_scale.value if quant else None,
+                    quant=quant,
                 )
             else:
-                _store_paged_kv(cached_k, tbl, k, pos)
-                _store_paged_kv(cached_v, tbl, v, pos)
-                k_read = _gather_pages(cached_k.value, tbl)
-                v_read = _gather_pages(cached_v.value, tbl)
-            idx.value = pos + s
-            qpos = pos[..., None] + jnp.arange(s)
-            valid = (
-                jnp.arange(cfg.max_seq_len) <= qpos[..., :, None]
-            )  # (B, S, max_len): per-slot depths, like the unpaged path
-            out = grouped_masked_attention(
-                q, k_read, v_read, valid[:, None, :, :]
-            )
+                k_read = _decode_kv(
+                    _gather_pages(cached_k.value, tbl),
+                    _gather_pages(k_scale.value, tbl) if quant else None,
+                    quant, k.dtype,
+                )
+                v_read = _decode_kv(
+                    _gather_pages(cached_v.value, tbl),
+                    _gather_pages(v_scale.value, tbl) if quant else None,
+                    quant, v.dtype,
+                )
+                qpos = pos[..., None] + jnp.arange(s)
+                valid = (
+                    jnp.arange(cfg.max_seq_len) <= qpos[..., :, None]
+                )  # (B, S, max_len): per-slot depths, like the unpaged path
+                out = grouped_masked_attention(
+                    q, k_read, v_read, valid[:, None, :, :]
+                )
         elif decode:
             # incremental decoding: S tokens in (S == 1 for the classic
             # generate()/serve step; S > 1 is a CHUNKED continuation — the
@@ -669,26 +784,24 @@ class Attention(nn.Module):
             # its own depth); apply_rope, _store_decode_kv, and the
             # validity mask all branch on the trace-time rank
             pos = idx.value
+            quant = _kv_quant_mode(cfg.kv_cache_dtype)
             q = apply_rope(q_raw, cfg.rope_theta, offset=pos)
             k = apply_rope(k_raw, cfg.rope_theta, offset=pos)
-            if k_scale is not None:  # int8 cache: store q + scale
-                k_q, k_s = _quantize_kv(k)
-                v_q, v_s = _quantize_kv(v)
-                _store_decode_kv(cached_k, k_q, pos)
-                _store_decode_kv(cached_v, v_q, pos)
+            k_q, k_s = _encode_kv(k, quant)  # quantized: store q + scale
+            v_q, v_s = _encode_kv(v, quant)
+            _store_decode_kv(cached_k, k_q, pos)
+            _store_decode_kv(cached_v, v_q, pos)
+            if quant:
                 _store_decode_kv(k_scale, k_s, pos)
                 _store_decode_kv(v_scale, v_s, pos)
-                k_read = _dequantize_kv(
-                    cached_k.value, k_scale.value, k.dtype
-                )
-                v_read = _dequantize_kv(
-                    cached_v.value, v_scale.value, v.dtype
-                )
-            else:
-                _store_decode_kv(cached_k, k, pos)
-                _store_decode_kv(cached_v, v, pos)
-                k_read = cached_k.value
-                v_read = cached_v.value
+            k_read = _decode_kv(
+                cached_k.value, k_scale.value if quant else None,
+                quant, k.dtype,
+            )
+            v_read = _decode_kv(
+                cached_v.value, v_scale.value if quant else None,
+                quant, v.dtype,
+            )
             idx.value = pos + s
             # attend over the whole cache: query token i (global position
             # pos + i) masks positions beyond pos + i — same math as
@@ -721,29 +834,23 @@ class Attention(nn.Module):
                 cached_k, cached_v, idx, k_scale, v_scale = self._cache_vars(
                     b, k_raw.dtype, v.dtype
                 )
-                if k_scale is not None:  # int8 cache
-                    k_q, k_s = _quantize_kv(k)
-                    v_q, v_s = _quantize_kv(v)
-                    cached_k.value = jax.lax.dynamic_update_slice(
-                        cached_k.value, k_q, (0, 0, 0, 0)
-                    )
-                    cached_v.value = jax.lax.dynamic_update_slice(
-                        cached_v.value, v_q, (0, 0, 0, 0)
-                    )
+                quant = _kv_quant_mode(cfg.kv_cache_dtype)
+                k_q, k_s = _encode_kv(k, quant)  # quantized cache: q+scale
+                v_q, v_s = _encode_kv(v, quant)
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k_q.astype(cached_k.value.dtype),
+                    (0, 0, 0, 0)
+                )
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v_q.astype(cached_v.value.dtype),
+                    (0, 0, 0, 0)
+                )
+                if quant:
                     k_scale.value = jax.lax.dynamic_update_slice(
                         k_scale.value, k_s, (0, 0, 0)
                     )
                     v_scale.value = jax.lax.dynamic_update_slice(
                         v_scale.value, v_s, (0, 0, 0)
-                    )
-                else:
-                    cached_k.value = jax.lax.dynamic_update_slice(
-                        cached_k.value, k.astype(cached_k.value.dtype),
-                        (0, 0, 0, 0)
-                    )
-                    cached_v.value = jax.lax.dynamic_update_slice(
-                        cached_v.value, v.astype(cached_v.value.dtype),
-                        (0, 0, 0, 0)
                     )
                 idx.value = jnp.asarray(s, jnp.int32)
             attn = (

@@ -24,9 +24,16 @@ from pytorch_distributed_training_tutorials_tpu.ops.fused_optim import (  # noqa
     FusedAdamWState,
     fused_adamw,
 )
+from pytorch_distributed_training_tutorials_tpu.ops.paged_attention import (  # noqa: F401
+    paged_attention,
+    paged_attention_reference,
+)
 from pytorch_distributed_training_tutorials_tpu.ops.quant import (  # noqa: F401
     Int8Dense,
     Int8Param,
     int8_matmul,
+    pack_int4,
     quantize_int8,
+    quantize_kv_int4,
+    unpack_int4,
 )

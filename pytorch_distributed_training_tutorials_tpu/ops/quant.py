@@ -69,6 +69,75 @@ def quantize_int8(w: jax.Array, channel_axis: int = -1) -> Int8Param:
     return Int8Param(q=q, scale=scale)
 
 
+def pack_int4(q: jax.Array) -> jax.Array:
+    """Pack int4 values (any int dtype, range [-7, 7]) two-per-byte along
+    the last axis: uint8 byte ``j`` holds element ``j`` in the low nibble
+    and element ``j + D/2`` in the high nibble (the HALF-SPLIT layout —
+    unpacking is one mask, one shift, and a concatenate, with no
+    elementwise interleave for Mosaic to scalarize; the same front/back
+    split :func:`..models.transformer.apply_rope` uses). Last axis must be
+    even; output shape ``(..., D // 2)``.
+
+    Reference capability (SURVEY.md C13 lineage): the 4-bit half of the
+    bitsandbytes load_in_*bit family (``/root/reference/
+    03.model_parallel.ipynb`` cell 2 loads the 8-bit variant; int4 is the
+    same absmax scheme at half the bits). Inverse: :func:`unpack_int4`.
+    """
+    d = q.shape[-1]
+    if d % 2:
+        raise ValueError(f"pack_int4 needs an even last axis, got {d}")
+    u = q.astype(jnp.uint8) & 0xF  # two's-complement nibble
+    lo, hi = u[..., : d // 2], u[..., d // 2 :]
+    return lo | (hi << 4)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_int4`: uint8 ``(..., D/2)`` -> int8
+    ``(..., D)``. Each nibble sign-extends through the two's-complement
+    rule ``n >= 8 -> n - 16`` (branch-free ``jnp.where`` — values are
+    traced data)."""
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+    ext = lambda n: jnp.where(n >= 8, n - 16, n)  # noqa: E731
+    return jnp.concatenate([ext(lo), ext(hi)], axis=-1)
+
+
+def quantize_kv_int4(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """int4 twin of ``models.transformer._quantize_kv``: quantize K/V
+    ``(..., D)`` to packed int4 (two nibbles per byte, :func:`pack_int4`)
+    with per-token-per-head scales (absmax over the head_dim vector /
+    7 — the symmetric absmax scheme of :func:`quantize_int8` at 4 bits).
+
+    Scales are stored **bfloat16**, not f32: that makes an int4 cache
+    entry cost exactly half its int8 twin per token-head (``D/2 + 2``
+    bytes vs ``D + 4``) — the "2x pages at equal HBM" claim is exact, not
+    approximate. Quantization divides by the ROUNDED bf16 scale so
+    dequantization with the stored scale is exact (no f32-vs-bf16 scale
+    mismatch); bf16's 8 mantissa bits are noise next to the ~1/15
+    relative step of 4-bit values. Inverse: :func:`dequantize_kv_int4`.
+    """
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = (jnp.maximum(absmax, 1e-8) / 7.0).astype(jnp.bfloat16)
+    q = jnp.clip(
+        jnp.round(x32 / scale.astype(jnp.float32)[..., None]), -7, 7
+    ).astype(jnp.int8)
+    return pack_int4(q), scale
+
+
+def dequantize_kv_int4(packed: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Packed int4 cache + bf16 scales -> compute dtype (the
+    ``_dequantize_kv`` twin). The unpack + multiply is elementwise, so XLA
+    fuses it into the attention matmuls' operand reads on the gather
+    path; the Pallas kernel (:mod:`.paged_attention`) runs the same
+    nibble math per page tile in VMEM — this function is its numerics
+    reference."""
+    q = unpack_int4(packed)
+    return (
+        q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]
+    ).astype(dtype)
+
+
 def _int8_matmul_kernel(x_ref, q_ref, sw_ref, out_ref, acc_ref, *, n_k: int):
     """One (TM, TN, TK) tile: quantize the x tile per row, int8 MXU matmul,
     accumulate the dequantized partial in f32 VMEM scratch; write out on the

@@ -46,7 +46,11 @@ from pytorch_distributed_training_tutorials_tpu.utils.tree import keystr as _pat
 # budgets, n-gram history, adapter ids — falls through to the replicated
 # default: per-slot bookkeeping is tiny and every shard needs it whole.
 # GQA degenerates safely: a kv_heads dim the model axis does not divide
-# drops to replicated via ``spec_for_path``'s shape check.
+# drops to replicated via ``spec_for_path``'s shape check. ISSUE 17's
+# int4-packed leaves need no new rule: packing halves the trailing
+# head_dim (rank unchanged, head axis still at -2) and bf16 scales keep
+# the rank-3-trailing scale shape, so the SAME four patterns cover int8
+# and int4 families alike.
 SLOT_STATE_RULES = [
     (r"cached_(key|value)_scale$", PartitionSpec(None, None, MODEL_AXIS)),
     (r"cached_(key|value)$", PartitionSpec(None, None, MODEL_AXIS, None)),

@@ -72,7 +72,13 @@ never fit the pool must shed synchronously at submit
 with the prefix cache ON must show page SHARES (retained prefix pages
 seeding new requests copy-free) while staying byte-identical to the
 paged cache-off leg. ``page_stats()`` (occupancy high-water, shares,
-sheds) rides into the receipt. A tenth (``--tp N``) arm replays the
+sheds) rides into the receipt. The paged arm also runs the ISSUE 17
+legs: the fused Pallas page-walk kernel (``paged_kernel=True``) must be
+token-exact to the gather engine at full precision, and the int4
+packed-KV engine (``kv_bits=4``) must price ``page_bytes`` at EXACTLY
+half the int8 engine's — 2x the pages at equal pool HBM — while
+completing the same stream through the kernel read path within the
+unchanged fetch budget. A tenth (``--tp N``) arm replays the
 base staggered stream through a :class:`..parallel.TensorParallel`-
 sharded engine on a ``{'model': N}`` mesh (ISSUE 15): greedy tokens
 must stay byte-identical to the replicated engine, the fetch budget is
@@ -785,6 +791,60 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
                 f"paged prefix leg: no page shares on an overlapping "
                 f"stream: {pxstats}"
             )
+        # kernel leg (ISSUE 17): the same stream through the fused
+        # Pallas page-walk read path — full-precision greedy must be
+        # token-exact to the gather engine (and so to whole-slot), at
+        # the unchanged fetch budget
+        eng_kn, toks_kn, fetches_kn = run_paged_stream(
+            paged_reqs, page_kw=dict(paged_kernel=True, **geometry),
+        )
+        kernel_exact = toks_kn == toks_ws
+        if not kernel_exact:
+            problems.append(
+                f"paged kernel changed greedy tokens: {toks_kn} != "
+                f"{toks_ws}"
+            )
+        kn_budget = eng_kn.n_chains + eng_kn.n_prefills
+        if fetches_kn > kn_budget:
+            problems.append(
+                f"paged kernel leg: {fetches_kn} host fetches > "
+                f"{kn_budget} (chains + prefills)"
+            )
+        # int4 leg (ISSUE 17): packed-nibble KV halves page_bytes
+        # EXACTLY (bf16 scales: d/2 + 2 vs d + 4 per token-head), so
+        # 2x the pages fit the int8 pool's HBM — the stream must still
+        # complete every request (int4 rounding moves near-tie tokens,
+        # so no exactness pin vs full precision) within budget
+        eng_i8, _, _ = run_paged_stream(
+            paged_reqs, page_kw=dict(kv_bits=8, **geometry),
+        )
+        eng_i4, toks_i4, fetches_i4 = run_paged_stream(
+            paged_reqs,
+            page_kw=dict(
+                kv_bits=4, paged_kernel=True, paged=True,
+                page_size=8, pool_pages=12,
+            ),
+        )
+        pb8 = eng_i8.page_stats()["page_bytes"]
+        pb4 = eng_i4.page_stats()["page_bytes"]
+        int4_halved = pb4 * 2 == pb8
+        if not int4_halved:
+            problems.append(
+                f"int4 page_bytes {pb4} is not exactly half of int8's "
+                f"{pb8}"
+            )
+        int4_ok = (
+            len(toks_i4) == len(paged_reqs)
+            and all(
+                len(toks_i4[rid]) > 0 for rid in toks_i4
+            )
+            and fetches_i4 <= eng_i4.n_chains + eng_i4.n_prefills
+        )
+        if not int4_ok:
+            problems.append(
+                f"int4 kernel leg incomplete or over budget: "
+                f"{len(toks_i4)} completions, {fetches_i4} fetches"
+            )
         paged_fields = {
             "paged_requests": len(paged_reqs),
             "paged_token_exact": paged_exact,
@@ -792,6 +852,10 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             "paged_shed_ok": paged_shed,
             "paged_prefix_token_exact": paged_prefix_exact,
             "paged_prefix_shares": pxstats.get("pages_shares", 0),
+            "paged_kernel_token_exact": kernel_exact,
+            "paged_int4_page_bytes_halved": int4_halved,
+            "paged_int4_ok": int4_ok,
+            "paged_int4_pool_pages": 12,
             **pgstats,
         }
 
@@ -1311,7 +1375,10 @@ def main(argv: list[str] | None = None) -> int:
         help="also run the paged-KV arm: an oversubscribed mixed stream "
         "through a page-pool engine, token-identical to whole-slot with "
         "the same fetch budget, PoolExhausted shed at submit, and "
-        "copy-free page sharing under the prefix cache (ISSUE 13)",
+        "copy-free page sharing under the prefix cache (ISSUE 13); "
+        "includes the ISSUE 17 legs — fused page-walk kernel "
+        "token-exact at full precision, int4 page_bytes exactly half "
+        "of int8's",
     )
     parser.add_argument(
         "--tp", type=int, default=0,

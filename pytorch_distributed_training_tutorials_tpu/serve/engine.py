@@ -149,6 +149,7 @@ from pytorch_distributed_training_tutorials_tpu.models.sampling import (
     speculative_accept,
 )
 from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    _kv_quant_mode,
     rewind_cache_index,
 )
 from pytorch_distributed_training_tutorials_tpu.parallel.tensor_parallel import (
@@ -285,6 +286,8 @@ class ServeEngine:
         page_size: int = 0,
         pool_pages: int = 0,
         strategy=None,
+        kv_bits: int | None = None,
+        paged_kernel: bool = False,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -301,6 +304,23 @@ class ServeEngine:
         elif page_size or pool_pages:
             raise ValueError(
                 "page_size/pool_pages require paged=True"
+            )
+        # quantized KV + fused kernel (ISSUE 17): both ENGINE-static —
+        # kv_bits rebuilds the model config (a different cache storage
+        # dtype is a different compiled program family) and paged_kernel
+        # flips the decode read path between the jnp.take reference and
+        # the Pallas page-walk kernel. Per-request values for either
+        # would recompile; neither exists.
+        if kv_bits not in (None, 4, 8):
+            raise ValueError(
+                "kv_bits must be None (follow the model config), 8 "
+                "(int8 + f32 scales), or 4 (packed nibbles + bf16 "
+                "scales)"
+            )
+        if paged_kernel and not paged:
+            raise ValueError(
+                "paged_kernel=True requires paged=True (the kernel "
+                "walks the page pool; whole-slot decode has no pages)"
             )
         if speculative_k < 0:
             raise ValueError("speculative_k must be >= 0")
@@ -365,6 +385,26 @@ class ServeEngine:
             # make every jit below compile GSPMD-sharded programs
             # instead of replicated ones
             params = strategy.shard_state(params)
+        # kv_bits (ISSUE 17): override the cache storage dtype on the
+        # model the engine serves (bank twin included — the override
+        # runs AFTER the bank substitution so tenants quantize too).
+        # Params are untouched: kv_cache_dtype only shapes the mutable
+        # cache collection, so None keeps engine + model byte-identical
+        # to a no-kwarg construction. 8 -> int8 + f32 scales; 4 ->
+        # packed-nibble uint8 + bf16 scales, EXACTLY half int8's bytes
+        # per token-head (d/2 + 2 vs d + 4 — models/transformer.py
+        # _kv_storage), which is what makes "2x pages at fixed HBM" an
+        # identity rather than an approximation.
+        if kv_bits is not None:
+            model = type(model)(
+                cfg=dataclasses.replace(
+                    model.cfg,
+                    kv_cache_dtype="int4" if kv_bits == 4 else jnp.int8,
+                )
+            )
+        self._kv_bits = {None: 0, "int8": 8, "int4": 4}[
+            _kv_quant_mode(model.cfg.kv_cache_dtype)
+        ]
         self.model = model
         self.params = params
         self.n_slots = n_slots
@@ -392,16 +432,22 @@ class ServeEngine:
                 )
             self._pool = PagePool(pool_pages, page_size)
             self._pages_per_slot = self.window // self._page_size
+            # paged_kernel rides the decode model's config: the flag is
+            # trace-time structure (models/transformer.py branches on it
+            # in Python, never on a traced value), so kernel-off paged
+            # engines compile byte-identical programs to pre-kernel ones.
             self._dec_model = type(model)(
                 cfg=dataclasses.replace(
                     model.cfg, kv_pages=pool_pages,
                     kv_page_size=page_size,
+                    paged_kernel=bool(paged_kernel),
                 )
             )
         else:
             self._pool = None
             self._pages_per_slot = 0
             self._dec_model = model
+        self._paged_kernel = bool(paged_kernel)
         # speculate-k: 0 = off (the engine then compiles byte-identical
         # programs to the pre-speculation one — no hist state, old chain)
         self._spec = speculative_k > 0
@@ -2432,8 +2478,12 @@ class ServeEngine:
         (``pages_*`` counters, excluded from the fingerprint).
         ``hbm_high_water_bytes`` is the pool HBM high-water mark —
         ``high_water`` pages priced at the per-page leaf footprint —
-        the number the oversubscription win is stated in. Host
-        bookkeeping only — no device fetch."""
+        the number the oversubscription win is stated in. ``kv_bits``
+        (0 = full precision) and ``paged_kernel`` joined the
+        fingerprint in ISSUE 17 so int4/kernel rounds never gate
+        int8/gather ones; ``page_bytes`` already prices quantized
+        leaves honestly (int4's packed uint8 + bf16 scales halve it vs
+        int8 exactly). Host bookkeeping only — no device fetch."""
         if not self._paged:
             return {"paged": 0}
         return {
@@ -2441,6 +2491,8 @@ class ServeEngine:
             "page_size": self._page_size,
             "pool_pages": self._pool_pages,
             "page_bytes": self._page_bytes,
+            "kv_bits": self._kv_bits,
+            "paged_kernel": int(self._paged_kernel),
             "hbm_high_water_bytes":
                 self._pool.high_water * self._page_bytes,
             **{f"pages_{k}": v for k, v in self._pool.stats().items()},

@@ -187,6 +187,11 @@ def write_slot(cache, prefill_cache, slot, p_len, scan_layers: bool):
 # pool-leaf name -> the flat (unpaged) cache leaf it is filled from: the
 # engine prefills through the UNPAGED model (classic whole-window batch-1
 # cache), then write_slot_paged scatters that cache into the shared pools.
+# Quantized KV reuses the same four names for BOTH families (int8 storage
+# + f32 scales, and ISSUE 17's int4 packed-nibble uint8 storage + bf16
+# scales — models/transformer.py _kv_storage): only dtypes and the packed
+# head_dim change, so this map, the seq-axis reshape in write_slot_paged,
+# and parallel.SLOT_STATE_RULES cover int4 without a new case.
 _POOL_TO_FLAT = {
     "paged_key": "cached_key",
     "paged_value": "cached_value",

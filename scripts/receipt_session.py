@@ -4,10 +4,11 @@ Every feature since round 5 shipped with its real-chip receipt recipe
 documented but NOT taken (no tunnel window in those sessions): the
 fused train-step tail, the --server base arm, prefix splicing,
 speculation, multi-tenant adapters, deadlines, the flight recorder,
-request-loop pipelining, the fleet router, the paged KV pool, and now
-tensor-parallel serving. This script is the catch-up: it sequences all
-eleven arms so the next session with a chip runs ONE command instead
-of re-deriving eleven recipes from CLAUDE.md prose.
+request-loop pipelining, the fleet router, the paged KV pool,
+tensor-parallel serving, and now the fused paged-attention kernel with
+int4 KV. This script is the catch-up: it sequences all twelve arms so
+the next session with a chip runs ONE command instead of re-deriving
+twelve recipes from CLAUDE.md prose.
 
 Sequencing is the point — every serving arm shares one --ckpt_dir, so
 the ~10-min cold 1.2B quantize-on-load cost is paid exactly once (by
@@ -50,6 +51,7 @@ ARM_NAMES = (
     "pipeline",    # --pipeline-depth 2: wall tok/s vs device rate
     "fleet",       # --replicas 2 --qps 8: aggregate tok/s + ledger_ok
     "paged",       # --paged @ 4096 window: hbm_high_water_bytes claim
+    "paged_int4",  # --kv-bits 4 --paged-kernel: 2x pages, fused reads
     "tp",          # --tp 4: head-sharded decode, per-chip KV at 1/tp
 )
 
@@ -99,6 +101,14 @@ def build_session(round_no: int, ckpt_dir: str, out_dir: str):
         # long-window paged arm: slot count decoupled from the 4096
         # window; the interesting receipt field is hbm_high_water_bytes
         srv("paged", "--max_seq_len", "4096", "--paged"),
+        # int4 + fused-kernel arm (ISSUE 17): packed-nibble KV fits 2x
+        # the pages of the int8 arm at equal hbm_high_water_bytes, and
+        # the Pallas page-walk kernel drops the dense gathered-window
+        # traffic — expect MORE concurrent slots at the 4096 window and
+        # a shrunk gather+attention class in the obs.StepReport trace
+        # breakdown (tok/s itself is launch-bound on the tunnel)
+        srv("paged_int4", "--max_seq_len", "4096", "--paged",
+            "--kv-bits", "4", "--paged-kernel"),
         # tensor-parallel arm: head-sharded decode over the model axis;
         # the interesting fields are tp_kv_bytes_per_chip (1/tp of the
         # global cache) and tp_hlo_ok at tok/s within a few % of base

@@ -1,0 +1,241 @@
+"""ops/paged_attention.py: the fused page-walk decode kernel (ISSUE 17).
+
+The load-bearing pins:
+
+- kernel output matches :func:`paged_attention_reference` (the pure-jnp
+  restatement of the transformer gather path's math) to float tolerance
+  across page_size x heads x GQA x dtype geometries, with RAGGED
+  per-row ``cache_index`` — every row at a different depth, pages
+  partially filled;
+- sentinel pages (table entry == n_pages) are masked exactly: rows
+  whose tables mix live and sentinel pages agree with the reference,
+  an ALL-sentinel (parked) row returns zeros instead of NaN;
+- the quantized paths dequantize inside the kernel to the same values
+  the reference's dense dequant produces (int8 x f32 scales, int4
+  packed nibbles x bf16 scales);
+- int4 pack/unpack are exact inverses over the full nibble range and
+  ``quantize_kv_int4`` -> ``dequantize_kv_int4`` reconstructs within
+  one scale step of the input — with the scale stored in bf16 and the
+  quantizer dividing by the ROUNDED scale, dequant is EXACTLY
+  ``q * scale`` (no hidden second rounding);
+- S > 1 queries (the chunked-continuation decode the splice path uses)
+  apply the per-row causal rule ``t <= pos + s``.
+
+Everything runs interpret-mode on the CPU mesh like the other ops/
+kernels; the wide geometry sweep is slow-marked per the tier-1 time
+budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+)
+from pytorch_distributed_training_tutorials_tpu.ops.quant import (
+    dequantize_kv_int4,
+    pack_int4,
+    quantize_kv_int4,
+    unpack_int4,
+)
+
+
+def _setup(seed, b, s, h, kv, d, page_size, p_cap, n_pages, quant=None):
+    """Random q/pools/table/pos with RAGGED depths and sentinel tails:
+    row i holds ceil((pos[i]+s)/page_size) live pages, sentinel beyond."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    window = p_cap * page_size
+    # ragged: depths spread across the window, incl. depth 0 (row 0)
+    pos = np.linspace(0, window - s - 1, b).astype(np.int32)
+    kf = jnp.asarray(
+        rng.standard_normal((n_pages, page_size, kv, d)), jnp.float32
+    )
+    vf = jnp.asarray(
+        rng.standard_normal((n_pages, page_size, kv, d)), jnp.float32
+    )
+    # distinct physical pages per (row, live logical page), sentinel after
+    table = np.full((b, p_cap), n_pages, np.int32)
+    free = list(rng.permutation(n_pages))
+    for i in range(b):
+        live = -(-(int(pos[i]) + s) // page_size)
+        for p in range(min(live, p_cap)):
+            table[i, p] = free.pop()
+    kw = {}
+    if quant == "int8":
+        scale = jnp.max(jnp.abs(kf), axis=-1) / 127.0
+        k = jnp.round(kf / scale[..., None]).astype(jnp.int8)
+        vscale = jnp.max(jnp.abs(vf), axis=-1) / 127.0
+        v = jnp.round(vf / vscale[..., None]).astype(jnp.int8)
+        kw = dict(k_scale=scale, v_scale=vscale, quant="int8")
+    elif quant == "int4":
+        k, scale = quantize_kv_int4(kf)
+        v, vscale = quantize_kv_int4(vf)
+        kw = dict(k_scale=scale, v_scale=vscale, quant="int4")
+    else:
+        k, v = kf, vf
+    return q, k, v, jnp.asarray(table), jnp.asarray(pos), kw
+
+
+def _agree(q, k, v, table, pos, kw, atol=2e-5):
+    got = paged_attention(q, k, v, table, pos, **kw)
+    want = paged_attention_reference(q, k, v, table, pos, **kw)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=atol, rtol=1e-5
+    )
+
+
+def test_kernel_matches_reference_ragged_base():
+    """The core pin: 4 rows at 4 different depths (incl. 0), distinct
+    physical pages, sentinel tails — kernel == gather reference."""
+    _agree(*_setup(0, b=4, s=1, h=4, kv=4, d=16,
+                   page_size=8, p_cap=4, n_pages=24))
+
+
+def test_kernel_matches_reference_gqa():
+    """Grouped queries: 8 query heads over 2 kv heads share each page
+    tile; the score-tile row -> query-row mapping (r // grp) must hold."""
+    _agree(*_setup(1, b=3, s=1, h=8, kv=2, d=16,
+                   page_size=8, p_cap=3, n_pages=16))
+
+
+def test_kernel_matches_reference_multi_query_chunk():
+    """S > 1 (the chunked continuation): each query row s attends
+    t <= pos + s — the causal staircase inside one call."""
+    _agree(*_setup(2, b=2, s=4, h=4, kv=4, d=16,
+                   page_size=8, p_cap=4, n_pages=16))
+
+
+def test_all_sentinel_row_returns_zeros_not_nan():
+    """A parked row (every table entry sentinel) has l == 0; the flush's
+    safe-divide must yield zeros, never NaN."""
+    q, k, v, table, pos, kw = _setup(
+        3, b=3, s=1, h=4, kv=4, d=16, page_size=8, p_cap=3, n_pages=12
+    )
+    table = table.at[1].set(12)  # row 1: all-sentinel
+    out = np.asarray(paged_attention(q, k, v, table, pos, **kw))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))
+    # other rows unaffected by the parked neighbor
+    want = np.asarray(paged_attention_reference(q, k, v, table, pos, **kw))
+    np.testing.assert_allclose(out[0], want[0], atol=2e-5, rtol=1e-5)
+    np.testing.assert_allclose(out[2], want[2], atol=2e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("quant", ["int8", "int4"])
+def test_kernel_matches_reference_quantized(quant):
+    """In-kernel dequant == the reference's dense dequant: same scales,
+    same values, to float tolerance."""
+    _agree(*_setup(4, b=3, s=1, h=4, kv=2, d=16,
+                   page_size=8, p_cap=3, n_pages=16, quant=quant))
+
+
+def test_kernel_under_jit_with_traced_table_and_pos():
+    """table/pos are per-request DATA: one compile serves every page
+    assignment and depth (scalar prefetch, not trace constants)."""
+    q, k, v, table, pos, kw = _setup(
+        5, b=2, s=1, h=4, kv=4, d=16, page_size=8, p_cap=3, n_pages=12
+    )
+    fn = jax.jit(lambda t, p: paged_attention(q, k, v, t, p, **kw))
+    np.testing.assert_allclose(
+        np.asarray(fn(table, pos)),
+        np.asarray(paged_attention_reference(q, k, v, table, pos, **kw)),
+        atol=2e-5, rtol=1e-5,
+    )
+    # second call with a different assignment: same compiled program
+    table2 = jnp.flip(table, axis=0)
+    pos2 = jnp.flip(pos, axis=0)
+    np.testing.assert_allclose(
+        np.asarray(fn(table2, pos2)),
+        np.asarray(
+            paged_attention_reference(q, k, v, table2, pos2, **kw)
+        ),
+        atol=2e-5, rtol=1e-5,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("page_size", [8, 16])
+@pytest.mark.parametrize("h,kv", [(4, 4), (8, 2)])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_geometry_sweep(page_size, h, kv, dtype):
+    """The wide sweep: page_size x (heads, kv_heads) x query dtype.
+    bf16 queries loosen tolerance (bf16 has ~3 decimal digits)."""
+    q, k, v, table, pos, kw = _setup(
+        7, b=3, s=2, h=h, kv=kv, d=32,
+        page_size=page_size, p_cap=3, n_pages=16,
+    )
+    q = q.astype(dtype)
+    k, v = k.astype(dtype), v.astype(dtype)
+    got = paged_attention(q, k, v, table, pos, **kw)
+    want = paged_attention_reference(q, k, v, table, pos, **kw)
+    assert got.dtype == dtype
+    atol = 2e-5 if dtype == jnp.float32 else 3e-2
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=atol, rtol=1e-2,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("quant", ["int8", "int4"])
+def test_quantized_sweep_gqa_chunk(quant):
+    """Quantized x GQA x S>1 — the composition the engine's splice
+    continuation exercises."""
+    _agree(*_setup(8, b=2, s=3, h=8, kv=2, d=32,
+                   page_size=8, p_cap=4, n_pages=16, quant=quant))
+
+
+# ---------------------------------------------------------- int4 helpers
+
+
+def test_pack_unpack_roundtrip_exact():
+    """pack -> unpack is the identity over the whole int4 range [-8, 7]
+    on every lane pairing (front/back half-split, no interleave)."""
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.integers(-8, 8, (5, 7, 3, 16)), jnp.int8)
+    packed = pack_int4(q)
+    assert packed.dtype == jnp.uint8 and packed.shape == (5, 7, 3, 8)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)),
+                                  np.asarray(q))
+
+
+def test_pack_rejects_odd_lane():
+    with pytest.raises(ValueError):
+        pack_int4(jnp.zeros((2, 3), jnp.int8))
+
+
+def test_quantize_kv_int4_dequant_is_exact_in_the_scale():
+    """The bf16-scale contract: quantize divides by the ROUNDED scale,
+    so dequant is exactly q * scale — reconstruction error is bounded
+    by half a quant step of the STORED scale, and storage is exactly
+    d/2 + 2 bytes per token-head (the 2x-pages-vs-int8 identity)."""
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.standard_normal((3, 9, 2, 16)) * 5.0, jnp.float32)
+    packed, scale = quantize_kv_int4(x)
+    assert packed.dtype == jnp.uint8 and packed.shape[-1] == 8
+    assert scale.dtype == jnp.bfloat16 and scale.shape == x.shape[:-1]
+    deq = dequantize_kv_int4(packed, scale, jnp.float32)
+    # exact: deq == unpack(packed) * f32(scale), no second rounding
+    np.testing.assert_array_equal(
+        np.asarray(deq),
+        np.asarray(unpack_int4(packed), np.float32)
+        * np.asarray(scale, np.float32)[..., None],
+    )
+    # bounded: |x - deq| <= scale/2 per element (round-to-nearest)
+    err = np.abs(np.asarray(x) - np.asarray(deq))
+    bound = np.asarray(scale, np.float32)[..., None] * 0.5 + 1e-6
+    assert np.all(err <= bound), (err.max(), bound.min())
+
+
+def test_quantize_kv_int4_clips_saturated_values():
+    """Values at +/- absmax land on the +/-7 codes (the clip guards the
+    divide-by-rounded-bf16-scale overshoot), never wrap the nibble."""
+    x = jnp.asarray([[7.0, -7.0, 0.5, -0.5] * 4], jnp.float32)
+    packed, scale = quantize_kv_int4(x)
+    q = np.asarray(unpack_int4(packed))
+    assert q.max() <= 7 and q.min() >= -7
+    assert q[0, 0] == 7 and q[0, 1] == -7

@@ -1,7 +1,7 @@
 """scripts/receipt_session.py builds the deferred-receipt runbook.
 
 The script's job is sequencing, not measuring — so the CPU pin is that
-it builds exactly the eleven documented recipes (CLAUDE.md's "receipt
+it builds exactly the twelve documented recipes (CLAUDE.md's "receipt
 has NOT been taken yet" list) with one shared checkpoint dir and
 round-stamped output names, without importing jax or needing a chip.
 """
@@ -26,11 +26,11 @@ def _load():
     return mod
 
 
-def test_plan_covers_all_eleven_deferred_arms():
+def test_plan_covers_all_twelve_deferred_arms():
     mod = _load()
     plan = mod.build_session(6, "/ckpt", "/out")
     names = [n for n, _ in plan]
-    assert names == list(mod.ARM_NAMES) and len(names) == 11
+    assert names == list(mod.ARM_NAMES) and len(names) == 12
 
     cmds = dict(plan)
     # every serving arm shares the ONE checkpoint cache and is a
@@ -65,6 +65,12 @@ def test_plan_covers_all_eleven_deferred_arms():
     assert "--paged" in cmds["paged"]
     assert cmds["paged"][cmds["paged"].index("--max_seq_len") + 1] \
         == "4096"
+    # the int4 + fused-kernel arm (ISSUE 17): the paged recipe plus
+    # packed-nibble KV and the Pallas page-walk read path
+    pi4 = cmds["paged_int4"]
+    assert "--paged" in pi4 and "--paged-kernel" in pi4
+    assert pi4[pi4.index("--kv-bits") + 1] == "4"
+    assert pi4[pi4.index("--max_seq_len") + 1] == "4096"
     # the tp arm is the head-sharded decode recipe (ISSUE 15)
     assert cmds["tp"][cmds["tp"].index("--tp") + 1] == "4"
 
@@ -85,9 +91,10 @@ def test_dry_run_subprocess_prints_plan_without_running():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [ln for ln in out.stdout.splitlines() if ln.startswith("[")]
-    assert len(lines) == 11
+    assert len(lines) == 12
     assert any("SERVING_r99_tp.json" in ln for ln in lines)
     assert any("SERVING_r99_paged.json" in ln for ln in lines)
+    assert any("SERVING_r99_paged_int4.json" in ln for ln in lines)
     assert any("TRAIN_LLM_r99_fused.json" in ln for ln in lines)
     # dry run must not have created anything
     assert not os.path.exists(os.path.join(REPO, "receipts"))

@@ -2476,7 +2476,243 @@ def test_serve_selftest_paged_subprocess(tmp_path):
     assert receipt["paged_prefix_shares"] >= 1
     assert receipt["pages_in_use"] == 0
     assert receipt["hbm_high_water_bytes"] > 0
+    # the ISSUE 17 legs: fused kernel read path + packed int4 KV
+    assert receipt["paged_kernel_token_exact"] is True
+    assert receipt["paged_int4_page_bytes_halved"] is True
+    assert receipt["paged_int4_ok"] is True
+    assert receipt["paged_int4_pool_pages"] == 12
     assert load_receipt(json_path)["ok"] is True
+
+
+# ------------------------------------------- fused paged kernel + int4 KV
+# (ISSUE 17): the Pallas page-walk read path and the packed-nibble KV
+# family. Contracts: kernel-on full-precision greedy is token-exact to
+# the gather engine (the reference oracle) across layouts and the full
+# subsystem composition; the compiled kernel chain never materializes a
+# dense (slots, window, ...) gathered KV window (the fused_loss-style
+# no-live-buffer receipt); kernel-off / kv_bits-off engines are
+# byte-identical; int4 page_bytes is EXACTLY half of int8's.
+
+
+def test_paged_kernel_token_exact_base(model_params):
+    """The core ISSUE 17 pin: the fused page-walk kernel is invisible in
+    full-precision greedy tokens on the oversubscribed mixed stream —
+    and therefore (by the ISSUE 13 pin) exact vs whole-slot and
+    generate() too. Budget unchanged: chains + prefills."""
+    model, params = model_params
+    reqs = [(_prompt(970 + i, p), m) for i, (p, m) in enumerate(
+        [(3, 9), (17, 12), (5, 5), (2, 17)]
+    )]
+    _, out_g = _run_stream(model, params, reqs, **_paged_geometry())
+    calls = {"n": 0}
+    real_get = jax.device_get
+
+    def counting(x):
+        calls["n"] += 1
+        return real_get(x)
+
+    jax.device_get = counting
+    try:
+        eng_k, out_k = _run_stream(
+            model, params, reqs, paged_kernel=True, **_paged_geometry()
+        )
+    finally:
+        jax.device_get = real_get
+    assert [c.tokens for c in out_k] == [c.tokens for c in out_g]
+    assert calls["n"] == eng_k.n_chains + eng_k.n_prefills
+    st = eng_k.page_stats()
+    assert st["paged_kernel"] == 1 and st["kv_bits"] == 0
+
+
+@pytest.mark.parametrize(
+    "cfg_kwargs",
+    [
+        pytest.param(dict(scan_layers=True), marks=pytest.mark.slow),
+        pytest.param(dict(n_kv_heads=2), marks=pytest.mark.slow),
+        pytest.param(dict(kv_cache_dtype="int8"), marks=pytest.mark.slow),
+        pytest.param(dict(kv_cache_dtype="int4"), marks=pytest.mark.slow),
+    ],
+    ids=["scan_layers", "gqa", "int8kv", "int4kv"],
+)
+def test_paged_kernel_token_exact_layouts(cfg_kwargs):
+    """Kernel-vs-gather engine exactness generalizes across the scanned,
+    GQA, int8-KV, and int4-KV cache layouts: both read paths see the
+    same stored (possibly quantized) K/V, so tokens match even where
+    quantization itself moved them off full precision."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, **cfg_kwargs)
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    reqs = [(_prompt(975 + i, p), m) for i, (p, m) in enumerate(
+        [(3, 9), (17, 12), (2, 17)]
+    )]
+    _, out_g = _run_stream(model, params, reqs, **_paged_geometry())
+    _, out_k = _run_stream(model, params, reqs, paged_kernel=True,
+                           **_paged_geometry())
+    assert [c.tokens for c in out_k] == [c.tokens for c in out_g]
+
+
+@pytest.mark.slow
+def test_paged_kernel_composed_spec_adapters_pipeline(model_params):
+    """The full composition through the kernel read path: paged + prefix
+    cache + speculation + multi-tenant adapters + depth-2 pipelining
+    with chunked prefill, token-exact to the same composition on the
+    gather engine (splice seeds, verify forwards, and chunk
+    continuations all route their S>1 reads through the kernel)."""
+    model, params = model_params
+    bank = _lora_bank(model)
+    reqs = _overlap_stream(0.7, n_requests=8)
+    kw = dict(
+        prefix_cache_bytes=16 * 1024 * 1024, speculative_k=2,
+        adapter_bank=bank, pipeline_depth=2, prefill_chunk=8,
+        **_paged_geometry(pool_pages=16),
+    )
+
+    def run(**extra):
+        engine = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=8, **kw, **extra
+        )
+        ids = [
+            engine.submit(Request(prompt=p, max_new_tokens=m, seed=i,
+                                  adapter=(i % 3) % 2 + 1 if i % 3 else 0))
+            for i, (p, m) in enumerate(reqs)
+        ]
+        out = {c.request_id: c for c in engine.run_until_idle()}
+        return [out[r].tokens for r in ids]
+
+    assert run(paged_kernel=True) == run()
+
+
+def _chain_hlo(engine) -> str:
+    """AOT-compiled decode-chain HLO (the audit_decode_hlo idiom: one
+    extra compile, fine on the CPU mesh)."""
+    return engine._chain.lower(
+        engine.params, engine._state
+    ).compile().as_text()
+
+
+def _window_shapes(ns, w, kv):
+    """Shape-literal regexes for a dense gathered KV window: every
+    storage dtype the cache families use, any head_dim — the
+    fused_loss-style no-live-buffer patterns."""
+    return [
+        rf"(f32|bf16|f16|s8|u8)\[{ns},{w},{kv},\d+\]",
+        # scanned layouts put the layer axis first
+        rf"(f32|bf16|f16|s8|u8)\[\d+,{ns},{w},{kv},\d+\]",
+    ]
+
+
+def test_paged_kernel_no_dense_window_in_chain_hlo(model_params):
+    """The acceptance receipt: the compiled kernel-path decode chain
+    contains NO dense (n_slots, window, kv, d) gathered temporary —
+    while the gather path (the positive control proving the patterns
+    detect what they claim) provably does."""
+    import re
+
+    model, params = model_params
+    ns, w, kv = 2, CFG.max_seq_len, CFG.n_heads
+    mk = lambda **extra: ServeEngine(  # noqa: E731
+        model, params, n_slots=ns, tokens_per_launch=8,
+        **_paged_geometry(), **extra,
+    )
+    gather_txt = _chain_hlo(mk())
+    kernel_txt = _chain_hlo(mk(paged_kernel=True))
+    hit = [p for p in _window_shapes(ns, w, kv)
+           if re.search(p, gather_txt)]
+    assert hit, "positive control: gather chain must materialize the window"
+    for pat in _window_shapes(ns, w, kv):
+        assert not re.search(pat, kernel_txt), (
+            f"kernel chain materializes a dense window: {pat}"
+        )
+
+
+def test_paged_kernel_off_engine_unchanged(model_params):
+    """paged_kernel=False (the default) keeps the gather engine bit for
+    bit: byte-identical slot state, the decode model's config carries
+    the flag off (so every chain jaxpr is unchanged — the flag is
+    trace-time structure), and page_stats reports it 0. kv_bits=None
+    likewise changes nothing."""
+    model, params = model_params
+    eng = ServeEngine(model, params, n_slots=2, tokens_per_launch=8,
+                      **_paged_geometry())
+    explicit = ServeEngine(model, params, n_slots=2, tokens_per_launch=8,
+                           paged_kernel=False, kv_bits=None,
+                           **_paged_geometry())
+    assert eng._dec_model.cfg.paged_kernel is False
+    assert eng._dec_model.cfg == explicit._dec_model.cfg
+    assert _tree_identical(eng._state, explicit._state)
+    assert eng.page_stats()["paged_kernel"] == 0
+    # the unpaged engine never even carries the flag's model twin
+    plain = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    assert plain._dec_model is model
+
+
+def test_kv_bits_validation(model_params):
+    """Engine-static knobs validate synchronously at construction."""
+    model, params = model_params
+    with pytest.raises(ValueError):  # kernel needs a page pool
+        ServeEngine(model, params, n_slots=2, paged_kernel=True)
+    with pytest.raises(ValueError):  # only None/8/4 exist
+        ServeEngine(model, params, n_slots=2, kv_bits=2)
+
+
+def test_kv_bits_int4_doubles_pages_at_equal_hbm(model_params):
+    """The 2x claim as an identity, not an approximation: int4 storage
+    (packed nibbles + bf16 scales) prices page_bytes at EXACTLY half of
+    int8's (d/2 + 2 vs d + 4 bytes per token-head), so a 2x-page pool
+    costs the same HBM — and the oversubscribed stream still completes
+    through the kernel read path within the unchanged fetch budget."""
+    model, params = model_params
+    reqs = [(_prompt(985 + i, p), m) for i, (p, m) in enumerate(
+        [(3, 9), (17, 12), (2, 17)]
+    )]
+    eng8, out8 = _run_stream(model, params, reqs, kv_bits=8,
+                             **_paged_geometry(pool_pages=6))
+    eng4, out4 = _run_stream(model, params, reqs, kv_bits=4,
+                             paged_kernel=True,
+                             **_paged_geometry(pool_pages=12))
+    s8, s4 = eng8.page_stats(), eng4.page_stats()
+    assert s4["page_bytes"] * 2 == s8["page_bytes"]
+    assert (s4["pool_pages"] * s4["page_bytes"]
+            == s8["pool_pages"] * s8["page_bytes"])
+    assert s8["kv_bits"] == 8 and s4["kv_bits"] == 4
+    for (_, m), c in zip(reqs, out4):
+        assert len(c.tokens) == m and c.finish_reason == "length"
+    # int4 leaf families: packed uint8 K/V at half head_dim, bf16 scales
+    leaves = {
+        str(getattr(p[-1], "key", p[-1])): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(
+            eng4._state["cache"]
+        )[0]
+    }
+    assert leaves["paged_key"].dtype == jnp.uint8
+    assert leaves["paged_key_scale"].dtype == jnp.bfloat16
+    k8 = {
+        str(getattr(p[-1], "key", p[-1])): leaf
+        for p, leaf in jax.tree_util.tree_flatten_with_path(
+            eng8._state["cache"]
+        )[0]
+    }
+    assert leaves["paged_key"].shape[-1] * 2 == k8["paged_key"].shape[-1]
+
+
+def test_kv_bits_follows_model_config(model_params):
+    """kv_bits=4 on a full-precision model is the same engine as
+    kv_bits=None on a model whose config already says "int4" — the
+    kwarg is a config override, not a second quantization path."""
+    import dataclasses
+
+    model, params = model_params
+    reqs = [(_prompt(995 + i, p), m)
+            for i, (p, m) in enumerate([(5, 8), (9, 6)])]
+    _, out_kw = _run_stream(model, params, reqs, kv_bits=4)
+    cfg4 = dataclasses.replace(CFG, kv_cache_dtype="int4")
+    model4 = TransformerLM(cfg4)
+    _, out_cfg = _run_stream(model4, params, reqs)
+    assert [c.tokens for c in out_kw] == [c.tokens for c in out_cfg]
 
 
 @pytest.mark.slow

@@ -360,6 +360,41 @@ def test_traced_control_flow_catches_python_branch_on_page_table():
     assert not hits(check(clean), "traced-control-flow")
 
 
+def test_traced_control_flow_catches_branch_on_kernel_selector():
+    """The fused-kernel foot-gun (ISSUE 17): kernel-vs-gather dispatch
+    must be ENGINE-static — a Python branch on a traced value (e.g. the
+    slot's cache_index deciding "deep enough for the kernel") fires,
+    while the sanctioned idiom (branching on a config bool, trace-time
+    structure like models/transformer.py's ``cfg.paged_kernel``) stays
+    silent."""
+    src = """
+        import jax
+
+        @jax.jit
+        def attend(q, pool, table, cache_index):
+            if cache_index.max() > 128:   # depth is data!
+                return paged_attention(q, pool, table, cache_index)
+            return gather_attention(q, pool, table, cache_index)
+    """
+    found = hits(check(src), "traced-control-flow")
+    assert len(found) == 1 and found[0].line == 6
+
+    clean = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def attend(q, pool, table, cache_index, cfg=None):
+            # engine-static dispatch: the flag is trace-time structure
+            # (a static config bool), so each config compiles ONE read
+            # path — selection between prebuilt programs stays legal
+            if cfg.paged_kernel:
+                return paged_attention(q, pool, table, cache_index)
+            return gather_attention(q, pool, table, cache_index)
+    """
+    assert not hits(check(clean), "traced-control-flow")
+
+
 # -------------------------------------------------------------- host-sync-hazard
 
 def test_host_sync_fires_inside_jit():

@@ -305,3 +305,22 @@ def test_tp2_composed_full_stack(model_params):
     assert fetches_t == (
         eng_t.n_chains + eng_t.n_prefills + eng_t.n_splices
     )
+
+
+@pytest.mark.slow
+def test_tp2_paged_kernel_token_exact(model_params):
+    """ISSUE 17 x ISSUE 15: the fused page-walk read path under tp=2 is
+    token-exact to the replicated gather engine on the oversubscribed
+    paged stream. (On the CPU mesh the interpret-mode kernel lowers to
+    plain HLO, so GSPMD shards it like the gather twin; a real-chip TP
+    deployment of the kernel itself is a shard_map follow-up — the
+    per-kv-head grid axis is embarrassingly parallel.)"""
+    model, params = model_params
+    reqs = [(_prompt(870 + i, p), m) for i, (p, m) in enumerate(
+        [(3, 9), (17, 12), (2, 17)]
+    )]
+    kw = dict(paged=True, page_size=8, pool_pages=6)
+    _, out_r = _run_stream(model, params, reqs, **kw)
+    _, out_k = _run_stream(model, params, reqs, strategy=_tp(2),
+                           paged_kernel=True, **kw)
+    assert [c.tokens for c in out_k] == [c.tokens for c in out_r]
