@@ -376,6 +376,18 @@ def main():
         "wins, the loser is cancelled and absorbed); default off",
     )
     ap.add_argument(
+        "--disaggregate", default=None, metavar="NpMd",
+        help="for --server: prefill/decode-disaggregated fleet (ISSUE "
+        "18), e.g. 1p2d = one prefill-specialized replica (admission + "
+        "bucketed/chunked prefill + the prefix cache) feeding two "
+        "decode-specialized replicas (slots, speculation, paged pool) "
+        "through device-side KV handoffs routed by the FleetRouter. "
+        "Overrides --replicas; the receipt gains "
+        "n_prefill/n_decode_replicas + handoffs_moved, and the "
+        "interesting fields are ttft_p95 under mixed traffic and "
+        "ledger_ok (exactly-once across the transfer)",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -580,7 +592,7 @@ def main():
     # ~19 s tunnel stall would otherwise be charged to compile_s)
     int(jnp.zeros((), jnp.int32) + 1)
     if args.server:
-        if args.replicas > 1:
+        if args.replicas > 1 or args.disaggregate:
             serve_fleet_stream(args, cfg, lm, params, receipt)
         else:
             serve_request_stream(args, cfg, lm, params, receipt)
@@ -670,6 +682,7 @@ def _reset_serving_counters(engine) -> None:
     engine.n_deadline_expired = engine.n_cancelled = 0
     engine.nonfinite_quarantined = engine.n_prefill_errors = 0
     engine.n_chunks = 0
+    engine.n_handoffs_out = engine.n_handoffs_in = 0
     if engine.prefix is not None:
         engine.prefix.hits = engine.prefix.misses = 0
 
@@ -694,6 +707,21 @@ def _serving_strategy(lm):
     return TensorParallel(mesh, INT8_TP_RULES)
 
 
+def _parse_disaggregate(spec: str) -> tuple[int, int]:
+    """``"1p2d"`` -> ``(1, 2)``: the role geometry of a disaggregated
+    fleet (ISSUE 18). Both counts must be >= 1 — a fleet missing either
+    role can never complete a request."""
+    import re
+
+    m = re.fullmatch(r"(\d+)p(\d+)d", spec)
+    if not m or int(m.group(1)) < 1 or int(m.group(2)) < 1:
+        raise SystemExit(
+            f"--disaggregate wants NpMd with N,M >= 1 (e.g. 1p2d), "
+            f"got {spec!r}"
+        )
+    return int(m.group(1)), int(m.group(2))
+
+
 def _paged_kwargs(args, window: int) -> dict:
     """ServeEngine paged-geometry kwargs from the CLI flags. --pool-pages
     0 sizes the pool to the whole-slot footprint (slots * window worth of
@@ -713,6 +741,15 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
     stream through a :class:`...serve.FleetRouter` over N replica
     engines sharing one checkpoint's params (N KV-cache footprints in
     HBM — tenants-per-chip economics, but for whole replicas).
+
+    ``--disaggregate NpMd`` (ISSUE 18) builds a ROLE-split fleet
+    instead: N prefill-specialized replicas (prefix cache + chunked
+    prefill, no decode machinery) and M decode-specialized replicas
+    (spec/paged/pipelining, no prefix cache) joined by the router's
+    device-side KV handoff — ``--replicas`` is ignored in that mode and
+    the interesting receipt fields become ``ttft_p95`` under mixed
+    traffic, ``handoffs_moved`` (== completed requests), and
+    ``ledger_ok``.
 
     ``--qps`` makes the stream OPEN loop: Poisson arrivals from a
     seeded exponential inter-arrival process, submitted at their
@@ -777,9 +814,13 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
         return bank
 
     t0 = time.perf_counter()
-    engines = [
-        ServeEngine(
-            lm, params,
+    n_pre, n_dec = (
+        _parse_disaggregate(args.disaggregate)
+        if args.disaggregate else (0, 0)
+    )
+
+    def mk_engine(role: str | None = None) -> ServeEngine:
+        kw = dict(
             n_slots=args.slots,
             tokens_per_launch=args.tokens_per_launch,
             max_queue=max(64, args.requests),
@@ -797,12 +838,32 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
             strategy=_serving_strategy(lm),
             **_paged_kwargs(args, window),
         )
-        for _ in range(args.replicas)
-    ]
+        if role == "prefill":
+            # the prefill specialist keeps the prefix cache + chunked
+            # prefill (its whole job) and sheds decode-side machinery —
+            # spec/pipelining/paged pools never run on this replica
+            kw.update(role="prefill", speculative_k=0, pipeline_depth=1)
+            for k in ("paged", "page_size", "pool_pages", "paged_kernel"):
+                kw.pop(k, None)
+        elif role == "decode":
+            # the decode specialist keeps spec/paged/pipelining and
+            # sheds the prefix cache + chunking (prefill-side work it
+            # never performs)
+            kw.update(role="decode", prefix_cache_bytes=0,
+                      prefill_chunk=0)
+        return ServeEngine(lm, params, **kw)
+
+    if args.disaggregate:
+        engines = ([mk_engine("prefill") for _ in range(n_pre)]
+                   + [mk_engine("decode") for _ in range(n_dec)])
+    else:
+        engines = [mk_engine() for _ in range(args.replicas)]
     if args.tp > 1:
         # homogeneous fleet: one replica's compiled chain speaks for all
-        # (FleetRouter.stats passes the tp_* config keys through)
-        engines[0].audit_decode_hlo()
+        # (FleetRouter.stats passes the tp_* config keys through); in a
+        # disaggregated fleet the decode role owns the chain, so audit
+        # the first decode replica
+        engines[n_pre if args.disaggregate else 0].audit_decode_hlo()
     router = FleetRouter(
         engines,
         hedge_after_s=args.hedge_after,
@@ -826,16 +887,33 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
     # once before the timed stream (same compile/serve split as the
     # single-engine arm, N times over)
     t_compile = time.perf_counter()
-    for eng in engines:
-        for i in range(len(lengths)):
-            eng.submit(mk_request(
-                i, deadline_s=1e9 if args.deadline_s is not None else None,
-            ))
-        eng.run_until_idle()
+    warm_dl = 1e9 if args.deadline_s is not None else None
+    if args.disaggregate:
+        # role warmup drives the handoff path directly (prefill ->
+        # take_handoff -> decode accept), so each prefill replica
+        # compiles every prompt bucket and each decode replica compiles
+        # its accept splice + chain before the timed stream
+        import dataclasses
+
+        pre, dec = engines[:n_pre], engines[n_pre:]
+        for j in range(max(n_pre, n_dec)):
+            pe, de = pre[j % n_pre], dec[j % n_dec]
+            for i in range(len(lengths)):
+                req = mk_request(i, deadline_s=warm_dl)
+                rid = pe.submit(dataclasses.replace(req))
+                pe.run_until_idle()
+                de.accept(req, pe.take_handoff(rid))
+            de.run_until_idle()
+    else:
+        for eng in engines:
+            for i in range(len(lengths)):
+                eng.submit(mk_request(i, deadline_s=warm_dl))
+            eng.run_until_idle()
     compile_s = time.perf_counter() - t_compile
     for eng in engines:
         _reset_serving_counters(eng)
         eng._flight.reset()
+    router.n_handoffs_moved = 0
     router._flight.reset()
 
     # open-loop Poisson arrivals (qps > 0) or the up-front burst (0)
@@ -887,6 +965,9 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
         server_generated_tokens=toks,
         server_chains=sum(e.n_chains for e in engines),
         server_prefills=sum(e.n_prefills for e in engines),
+        server_handoffs=sum(
+            getattr(e, "n_handoffs_in", 0) for e in engines
+        ),
         server_p50_latency_s=round(rstats.get("e2e_p50_s", 0.0), 3),
         server_p95_latency_s=round(rstats.get("e2e_p95_s", 0.0), 3),
         server_ttft_p50_s=round(rstats.get("ttft_p50_s", 0.0), 3),
@@ -904,8 +985,12 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
     if args.flight_log:
         router.dump_fleet(args.flight_log, reason="end_of_stream")
         print(f"fleet flight log -> {args.flight_log}")
+    geometry = (
+        f"{n_pre}p+{n_dec}d role replicas" if args.disaggregate
+        else f"{args.replicas} replicas"
+    )
     print(
-        f"fleet: {args.requests} requests over {args.replicas} replicas "
+        f"fleet: {args.requests} requests over {geometry} "
         f"x {args.slots} slots in {wall_s:.2f}s — {toks / wall_s:.1f} "
         f"tok/s aggregate, qps {args.qps or 'burst'} ({shed} shed), "
         f"p95 {receipt['server_p95_latency_s']}s, ttft p95 "

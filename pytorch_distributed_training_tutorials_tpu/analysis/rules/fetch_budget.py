@@ -3,7 +3,9 @@
 THE serving invariant (CLAUDE.md): the fetch budget is exactly chains +
 prefills + splices — one batched ``jax.device_get`` per decode chain in
 ``_collect_chain``, one scalar fetch per prefill/splice in ``_refill`` /
-``_refill_paged`` / ``_advance_one``. Every other host sync in the
+``_refill_paged`` / ``_advance_one``, and one per accepted handoff in
+``_accept_refill`` (the disaggregated decode role's intake — its
+prefill-role counterpart fetches nothing). Every other host sync in the
 request loop is a stall the ~75-130 ms per-launch roundtrip multiplies:
 a stray ``.item()`` in a sweep or a ``device_get`` in a stats method
 silently turns a launch-amortized engine back into per-token traffic.
@@ -36,6 +38,9 @@ BUDGETED_FUNCTIONS = frozenset({
     "_refill",          # one scalar first-token fetch per prefill/splice
     "_refill_paged",    # the paged twin
     "_advance_one",     # chunked prefill's final-chunk scalar fetch
+    "_accept_refill",   # disaggregated handoff's scalar fetch (ISSUE 18:
+                        # the prefill role fetches NOTHING — the decode
+                        # role's accept splice carries the one fetch)
 })
 
 # Dotted call paths that force a device->host transfer or blocking wait.

@@ -137,6 +137,14 @@ CONFIG_FIELDS = (
     # (tp_collectives, tp_hlo_ok) and the per-chip KV footprint stay
     # out — outcomes, not configuration
     "mesh_shape",
+    # prefill/decode disaggregation (ISSUE 18): an engine's role and the
+    # fleet's role geometry change what a tok/s or TTFT number MEANS
+    # (a prefill replica's "throughput" is segments, a decode replica
+    # never prefills, and 1p2d vs 2p1d are different experiments), so
+    # disaggregated and monolithic rounds never gate each other; the
+    # handoff counters (handoffs_out/in/moved) stay out — outcomes of
+    # the traffic, not configuration
+    "role", "n_prefill_replicas", "n_decode_replicas",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

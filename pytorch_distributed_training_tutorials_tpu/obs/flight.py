@@ -75,6 +75,9 @@ EVENT_KINDS = frozenset({
     "hedge",             # router duplicated a straggler onto a second replica
     "pool_shed",         # paged KV: submit rejected, request > whole pool
     "page_cow",          # paged KV: copy-on-write split of a shared page
+    "handoff_emit",      # prefill-role engine finished a transferable prefill
+    "handoff_move",      # router moved a KV segment to a decode replica
+    "handoff_accept",    # decode-role engine spliced a handoff into a slot
 })
 
 # Faults trigger an auto-dump when a dump_path is configured.
@@ -203,10 +206,15 @@ class FlightRecorder:
     def request_prefilled(self, rid: Any, slot: int,
                           kind: str = "prefill",
                           cached_len: int = 0) -> None:
-        """``kind`` is "prefill" or "splice" (the prefix-cache path)."""
+        """``kind`` is "prefill", "splice" (the prefix-cache path) or
+        "handoff" (a decode-role engine accepting a transferred segment
+        — ISSUE 18; ``prefill_t`` still stamps here, the moment the
+        request's first token exists on THIS engine)."""
         t = self._now()
         if kind == "splice":
             self.record("splice", rid=rid, slot=slot, cached_len=cached_len)
+        elif kind == "handoff":
+            self.record("handoff_accept", rid=rid, slot=slot)
         else:
             self.record("prefill", rid=rid, slot=slot)
         span = self.spans.get(rid)

@@ -6,7 +6,9 @@ Public surface:
 - :class:`.engine.ServeEngine` — the engine (submit / step /
   run_until_idle);
 - :class:`.scheduler.Request` / :class:`.scheduler.Completion` — the
-  request/response records;
+  request/response records — and :class:`.scheduler.Handoff`, the
+  prefill→decode transfer record of the disaggregated path (ISSUE 18:
+  ``ServeEngine(role="prefill"/"decode")`` + role-aware routing);
 - :class:`.scheduler.FifoScheduler` / :class:`.scheduler.QueueFull` /
   :class:`.scheduler.QueueClosed` — the host-side queue and its
   backpressure/shutdown signals (``ServeEngine.close``/``drain`` stop
@@ -53,6 +55,7 @@ _LAZY_EXPORTS = {
     "Segment": "pytorch_distributed_training_tutorials_tpu.serve.prefix",
     "Completion": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "FifoScheduler": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
+    "Handoff": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "QueueClosed": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "QueueFull": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "Request": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",

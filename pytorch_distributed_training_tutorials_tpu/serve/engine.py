@@ -163,6 +163,7 @@ from pytorch_distributed_training_tutorials_tpu.serve.prefix import PrefixIndex
 from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
     Completion,
     FifoScheduler,
+    Handoff,
     Request,
 )
 from pytorch_distributed_training_tutorials_tpu.serve.slots import (
@@ -288,6 +289,7 @@ class ServeEngine:
         strategy=None,
         kv_bits: int | None = None,
         paged_kernel: bool = False,
+        role: str | None = None,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -326,6 +328,49 @@ class ServeEngine:
             raise ValueError("speculative_k must be >= 0")
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1 (1 = serial)")
+        # disaggregation (ISSUE 18): role=None is the monolithic engine
+        # (byte-identical state tree + compiled programs — no handoff
+        # twins are even constructed). A prefill-role engine runs
+        # admission + prefill only and EMITS segments; a decode-role
+        # engine ACCEPTS them and decodes. Features that only make
+        # sense on the other side are rejected at construction so a
+        # half-configured role can never exist: prefill never decodes
+        # (no paged pool, no speculation, no chains to pipeline) and
+        # decode never prefills a prompt (prefix cache + chunked
+        # prefill live where the prefill forward runs).
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"role must be None (monolithic), 'prefill', or "
+                f"'decode'; got {role!r}"
+            )
+        self._role = role
+        if role == "prefill":
+            if paged:
+                raise ValueError(
+                    "role='prefill' engines never decode — the paged "
+                    "pool belongs on the decode side"
+                )
+            if speculative_k:
+                raise ValueError(
+                    "role='prefill' engines never decode — speculation "
+                    "belongs on the decode side"
+                )
+            if pipeline_depth != 1:
+                raise ValueError(
+                    "role='prefill' engines dispatch no decode chains — "
+                    "pipeline_depth belongs on the decode side"
+                )
+        if role == "decode":
+            if prefix_cache_bytes:
+                raise ValueError(
+                    "role='decode' engines never prefill a prompt — the "
+                    "prefix cache belongs on the prefill side"
+                )
+            if prefill_chunk:
+                raise ValueError(
+                    "role='decode' engines never prefill a prompt — "
+                    "prefill_chunk belongs on the prefill side"
+                )
         if prefill_chunk and (
             prefill_chunk < 8 or prefill_chunk & (prefill_chunk - 1)
         ):
@@ -509,10 +554,12 @@ class ServeEngine:
         self._inflight: collections.deque[_InFlight] = collections.deque()
         self._pending: dict[int, _PendingPrefill] = {}
         self.n_chunks = 0
-        if self._retain or self._chunk:
+        if self._retain or self._chunk or role == "decode":
             # shape/dtype proto of the batch-1 decode cache — seed_cache
-            # builds the splice start state from it, and chunked prefill
-            # its zeroed side cache (eval_shape: no FLOPs, no buffers)
+            # builds the splice start state from it, chunked prefill its
+            # zeroed side cache, and a decode-role engine both validates
+            # incoming handoff segments against it and seeds their
+            # accept splice from it (eval_shape: no FLOPs, no buffers)
             self._proto1 = jax.eval_shape(
                 lambda p, t: self.model.apply(
                     {"params": p}, t, decode=True, mutable=["cache"]
@@ -555,6 +602,14 @@ class ServeEngine:
         self.n_cancelled = 0
         self.nonfinite_quarantined = 0
         self.n_prefill_errors = 0
+        # disaggregation (ISSUE 18): transfer records waiting for the
+        # router to collect (prefill role, keyed by request id) / to be
+        # spliced at refill (decode role); host dicts holding device
+        # futures — never fetched here
+        self._handoffs: dict[int, Handoff] = {}
+        self._handoff_in: dict[int, Handoff] = {}
+        self.n_handoffs_out = 0
+        self.n_handoffs_in = 0
         # donating the state tree lets XLA update the multi-hundred-MB
         # cache in place; CPU jit warns on donation (unsupported), so
         # only donate where it is real
@@ -650,6 +705,34 @@ class ServeEngine:
                     static_argnames=("seg_len", "grow"),
                     donate_argnums=(1, 2) if donate else (),
                 )
+        # disaggregation programs (ISSUE 18): role=None constructs
+        # NEITHER side, so monolithic engines keep a byte-identical
+        # compiled-program census. The prefill role's programs end in
+        # segment extraction instead of slot surgery; the decode role's
+        # accept is the prefix-splice surgery (seed_cache + write_slot)
+        # applied to a TRANSFERRED segment. The segment is never
+        # donated on either side — the prefill engine's prefix index
+        # (and the router, across replica death) may still serve it.
+        if role == "prefill":
+            self._handoff_prefill = jax.jit(self._handoff_prefill_fn)
+            if self._retain:
+                self._handoff_splice = jax.jit(
+                    self._handoff_splice_fn,
+                    static_argnames=("seg_len",),
+                )
+            if self._chunk:
+                # the accumulated side cache has exactly one consumer
+                self._handoff_final = jax.jit(
+                    self._handoff_final_fn,
+                    static_argnames=("seg_len",),
+                    donate_argnums=donate,
+                )
+        elif role == "decode":
+            self._accept_jit = jax.jit(
+                self._accept_paged_fn if self._paged
+                else self._accept_fn,
+                donate_argnums=donate,
+            )
 
     # ------------------------------------------------------------------
     # compiled programs (closures over model + static sampling params)
@@ -1112,6 +1195,158 @@ class ServeEngine:
             )
         return new_state, first[0]
 
+    # -- disaggregation twins (ISSUE 18) -----------------------------------
+
+    def _handoff_prefill_fn(self, params, tokens, p_len, seed, aid=0):
+        """Prefill-role miss path: the SAME batched prefill forward as
+        :meth:`_prefill_fn`, but instead of slot surgery the whole
+        prompt-bucket batch-1 cache rides out as a transferable segment
+        (:func:`.slots.extract_segment` over ``tokens.shape[1]`` — one
+        compile per pow2 bucket, the prefix-splice discipline). Returns
+        ``(segment, first, key)``, ALL device residents: the sampled
+        first token and the post-sample PRNG key travel with the
+        segment so the decode side continues the request's stream
+        exactly where a monolithic engine would. No fetch happens on
+        this engine, ever — the prefill-role budget is ZERO, pinned by
+        the device_get spy in tests/test_serve.py."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
+        logits, upd = self.model.apply(
+            {"params": params}, tokens, prefill=True, mutable=["cache"],
+            last_pos=p_len - 1, **kw,
+        )
+        key = jax.random.PRNGKey(seed)
+        first, key = sample_logits(
+            logits[:, -1].astype(jnp.float32), key,
+            self._temperature, self._top_k, self._top_p,
+        )
+        seg = self._pin(extract_segment(
+            upd["cache"], tokens.shape[1], self._scan_layers
+        ))
+        return seg, first[0], key
+
+    def _handoff_splice_fn(self, params, segment, suffix, depth, p_len,
+                           seed, aid=0, *, seg_len):
+        """Prefill-role prefix-hit path: seed from the retained donor
+        at ``depth`` and run the chunked decode continuation over the
+        uncached suffix (the same bitwise-equal-to-prefill math
+        :meth:`_splice_fn` uses), then extract the FULL prompt bucket
+        as the outgoing segment. ``seg_len`` is static — the pow2
+        bucket set keeps compiles bounded, never per request."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
+        cache1 = self._pin(seed_cache(self._proto1, segment, depth))
+        return self._handoff_from_cache(
+            params, cache1, suffix, p_len - 1 - depth, seed, kw, seg_len
+        )
+
+    def _handoff_final_fn(self, params, cache1, suffix, last_local,
+                          seed, aid=0, *, seg_len):
+        """Prefill-role final chunk of a chunked prefill: the decode
+        continuation over the accumulated side cache, ending in segment
+        extraction instead of slot surgery (the :meth:`_chunk_final_fn`
+        analogue — long prompts stream through the SAME exact-N mid
+        chunks on a prefill-role engine, so a disaggregated fleet keeps
+        the no-prefill-freeze property)."""
+        kw = {}
+        if self._adapters:
+            kw["adapter_ids"] = jnp.asarray(aid, jnp.int32)
+        return self._handoff_from_cache(
+            params, cache1, suffix, last_local, seed, kw, seg_len
+        )
+
+    def _handoff_from_cache(self, params, cache1, suffix, last_local,
+                            seed, kw, seg_len):
+        """Shared tail of the prefill-role splice / final-chunk
+        programs: continuation forward, first-token sample, full-bucket
+        segment extraction. A plain helper traced inline by its
+        callers, same pattern as :meth:`_finish_prefill`."""
+        logits, upd = self.model.apply(
+            {"params": params, "cache": cache1}, suffix, decode=True,
+            mutable=["cache"], last_pos=last_local, **kw,
+        )
+        key = jax.random.PRNGKey(seed)
+        first, key = sample_logits(
+            logits[:, -1].astype(jnp.float32), key,
+            self._temperature, self._top_k, self._top_p,
+        )
+        seg = self._pin(extract_segment(
+            upd["cache"], seg_len, self._scan_layers
+        ))
+        return seg, first[0], key
+
+    def _accept_fn(self, params, state, segment, full, first, key,
+                   p_len, slot, max_new, aid=0):
+        """Decode-role accept: rebuild the monolithic post-prefill slot
+        state from a transferred segment. ``seed_cache`` zero-fills the
+        batch-1 proto and lands the segment at the origin — positions
+        ``[0, bucket)`` then hold exactly what the prefill forward
+        wrote (pad-position K/V included) and everything beyond is
+        zero, which is bitwise what ``upd["cache"]`` looked like on the
+        prefill engine — and ``write_slot`` performs the IDENTICAL
+        splice :meth:`_prefill_fn` would have. Disaggregated
+        token-exactness is therefore BITWISE for every cache family
+        (int8/int4 included: nothing is recomputed, so quantization
+        never reassociates). ``first``/``key`` arrive as device
+        residents from the :class:`..serve.scheduler.Handoff`;
+        ``params`` is unused but keeps ``state`` at donate index 1 (the
+        segment, arg 2, is NEVER donated — the router may re-dispatch
+        it). ``full`` is the bucket-padded prompt seeding the n-gram
+        history — a dead operand when speculation is off, exactly like
+        :meth:`_splice_fn`'s."""
+        del params  # decode accept recomputes nothing
+        cache1 = self._pin(seed_cache(self._proto1, segment, p_len))
+        cache = self._pin(write_slot(
+            state["cache"], cache1, slot, p_len, self._scan_layers
+        ))
+        new_state = {
+            "cache": cache,
+            "last_tok": state["last_tok"].at[slot].set(first),
+            "keys": state["keys"].at[slot].set(key),
+            "remaining": state["remaining"].at[slot].set(max_new - 1),
+        }
+        if self._spec:
+            new_state.update(_seed_history(
+                state, full, p_len, slot, first
+            ))
+        if self._adapters:
+            new_state["adapter_ids"] = state["adapter_ids"].at[slot].set(
+                jnp.asarray(aid, jnp.int32)
+            )
+        return new_state, first
+
+    def _accept_paged_fn(self, params, state, segment, full, row, first,
+                         key, p_len, slot, max_new, aid=0):
+        """Paged decode-role accept: reconstruct the batch-1 cache as
+        in :meth:`_accept_fn`, then scatter it into the slot's fresh
+        pages (:func:`.slots.write_slot_paged` — full-row, so it
+        sanitizes recycled pages exactly like the paged prefill does).
+        Page geometry rides as the traced ``row`` vector; one compile
+        per segment bucket."""
+        del params
+        cache1 = self._pin(seed_cache(self._proto1, segment, p_len))
+        cache = self._pin(write_slot_paged(
+            state["cache"], cache1, row, slot, p_len,
+            self._page_size, self._scan_layers,
+        ))
+        new_state = {
+            "cache": cache,
+            "last_tok": state["last_tok"].at[slot].set(first),
+            "keys": state["keys"].at[slot].set(key),
+            "remaining": state["remaining"].at[slot].set(max_new - 1),
+        }
+        if self._spec:
+            new_state.update(_seed_history(
+                state, full, p_len, slot, first
+            ))
+        if self._adapters:
+            new_state["adapter_ids"] = state["adapter_ids"].at[slot].set(
+                jnp.asarray(aid, jnp.int32)
+            )
+        return new_state, first
+
     def _chain_fn(self, params, state):
         """``tokens_per_launch`` decode steps as one ``lax.scan`` — one
         launch, one (S, T) token block out. Every slot steps every time
@@ -1334,6 +1569,17 @@ class ServeEngine:
         tenant is evicted — or whose row is handed to a NEW tenant —
         while it queues completes as ``"adapter_evicted"`` instead of
         silently decoding under someone else's factors."""
+        if self._role == "decode":
+            raise ValueError(
+                "role='decode' engines admit work via accept(request, "
+                "handoff), not submit() — a prompt with no finished "
+                "prefill attached has nothing to decode from"
+            )
+        return self._admit(request)
+
+    def _admit(self, request: Request) -> int:
+        """Shared admission body of :meth:`submit` and :meth:`accept`:
+        adapter + paged checks, scheduler enqueue, flight stamp."""
         aid = int(getattr(request, "adapter", 0))
         if aid != 0 and not self._adapters:
             raise ValueError(
@@ -1376,6 +1622,78 @@ class ServeEngine:
             )
         return rid
 
+    def accept(self, request: Request, handoff: Handoff) -> int:
+        """Decode-role admission: enqueue ``request`` with its finished
+        prefill attached. The segment is validated against THIS
+        engine's cache layout first (heterogeneous fleets differ in
+        window / slot count per role — a mismatched segment must fail
+        here, synchronously, never inside a compiled program); adapter
+        and paged admission then run exactly as :meth:`submit`'s. The
+        handoff's original ``submitted_s`` is restored after the
+        scheduler re-stamps, so latency / TTFT span the ORIGINAL
+        submit on the prefill side, not the transfer."""
+        if self._role != "decode":
+            raise ValueError(
+                "accept() needs role='decode' — monolithic and "
+                "prefill-role engines take work via submit()"
+            )
+        self._validate_segment(handoff.segment)
+        rid = self._admit(request)
+        if handoff.submitted_s:
+            request.submitted_s = handoff.submitted_s
+        self._handoff_in[rid] = handoff
+        return rid
+
+    def take_handoff(self, request_id: int) -> Handoff:
+        """Pop the finished :class:`..serve.scheduler.Handoff` a
+        prefill-role engine emitted for ``request_id`` (the router
+        calls this when it sees the ``"handoff"`` completion). The
+        record leaves this engine's ownership — device buffers stay
+        alive through the handoff's own references."""
+        if self._role != "prefill":
+            raise ValueError(
+                "take_handoff() needs role='prefill' — only prefill-"
+                "role engines emit handoffs"
+            )
+        return self._handoffs.pop(request_id)
+
+    def _validate_segment(self, segment) -> None:
+        """Admission check for a transferred segment: the tree must
+        have THIS engine's batch-1 cache structure (dtype + rank per
+        leaf — a different KV quantization family is a different
+        structure and fails here) and fit the serving window (at most
+        one axis may differ from the window-length proto, and only
+        downward)."""
+        p_leaves, p_def = jax.tree_util.tree_flatten(self._proto1)
+        leaves, tdef = jax.tree_util.tree_flatten(segment)
+        if tdef != p_def:
+            raise ValueError(
+                "handoff segment does not match this engine's cache "
+                "layout (different model config or KV cache family?)"
+            )
+        for leaf, proto in zip(leaves, p_leaves):
+            if leaf.dtype != proto.dtype or leaf.ndim != proto.ndim:
+                raise ValueError(
+                    f"handoff segment leaf {leaf.dtype}/{leaf.ndim}d "
+                    f"does not match this engine's "
+                    f"{proto.dtype}/{proto.ndim}d cache leaf"
+                )
+            diff = [
+                i for i in range(leaf.ndim)
+                if leaf.shape[i] != proto.shape[i]
+            ]
+            if len(diff) > 1 or (
+                diff and leaf.shape[diff[0]] > proto.shape[diff[0]]
+            ):
+                raise ValueError(
+                    f"handoff segment leaf shape {leaf.shape} does not "
+                    f"fit this engine's window (proto {proto.shape})"
+                )
+
+    @property
+    def role(self) -> str | None:
+        return self._role
+
     @property
     def active_slots(self) -> int:
         return sum(a is not None for a in self._slots)
@@ -1387,6 +1705,19 @@ class ServeEngine:
             and len(self.scheduler) == 0
             and not self._pending
             and not self._inflight
+            and not self._handoff_in
+        )
+
+    @property
+    def load(self) -> int:
+        """Host-visible backlog: active + pending + queued + accepted
+        handoffs awaiting a slot. The router's least-loaded decode
+        placement key (ISSUE 18) — pure host counting, no fetch."""
+        return (
+            self.active_slots
+            + len(self._pending)
+            + len(self.scheduler)
+            + len(self._handoff_in)
         )
 
     def step(self) -> list[Completion]:
@@ -1689,6 +2020,10 @@ class ServeEngine:
             return [self._complete_unstarted(req, "adapter_evicted")]
         if aid:
             self.adapter_requests += 1
+        if self._role == "decode":
+            # disaggregated refill (ISSUE 18): the prefill already ran
+            # on another engine — splice its transferred segment in
+            return self._accept_refill(slot, req)
         prompt = [int(t) for t in req.prompt]
         p_len = len(prompt)
         bucket = bucket_len(p_len, self.window)
@@ -1707,6 +2042,10 @@ class ServeEngine:
             # co-scheduled slot for the whole prompt
             return self._begin_chunked(
                 slot, req, prompt, p_len, pkey, hit, grow, aid
+            )
+        if self._role == "prefill":
+            return self._refill_handoff(
+                slot, req, prompt, p_len, bucket, pkey, hit, grow, aid
             )
         if self._paged:
             return self._refill_paged(
@@ -1886,6 +2225,152 @@ class ServeEngine:
             hit[0] if segment is not None else 0, pages=pages,
         )
 
+    def _refill_handoff(self, slot: int, req: Request,
+                        prompt: list[int], p_len: int, bucket: int,
+                        pkey: list[int], hit, grow: bool,
+                        aid: int) -> list[Completion]:
+        """Prefill-role refill: run the prompt's prefill (or prefix
+        splice) and EMIT the finished segment as a
+        :class:`..serve.scheduler.Handoff` instead of occupying a slot.
+        Pure async dispatch — segment, first token and PRNG key stay
+        device futures, so a prefill-role engine performs ZERO fetches.
+        The request completes immediately with ``finish_reason ==
+        "handoff"`` (zero tokens here; the decode side reports them).
+        Prefix-index growth is unchanged: the outgoing segment doubles
+        as the insert candidate, so multi-turn streams deepen the
+        prefill side's index exactly as a monolithic engine's. A
+        raising prefill is isolated to its request (``"error"``, donor
+        unpinned, nothing was written to slot state so no park is
+        needed)."""
+        segment = None
+        try:
+            if self._chaos is not None:
+                chaos_lib.maybe_fail_prefill(self._chaos, req.request_id)
+            akw = {"aid": aid} if self._adapters else {}
+            if hit is not None:
+                depth, segment = hit
+                # pin the donor FIRST, same contract as _refill
+                self.prefix.acquire(segment)
+                suffix = prompt[depth:]
+                s_bucket = bucket_len(len(suffix), self.window)
+                tokens = jnp.asarray(
+                    [suffix + [0] * (s_bucket - len(suffix))], jnp.int32
+                )
+                seg, first, key = self._handoff_splice(
+                    self.params, segment.handle, tokens, depth, p_len,
+                    req.seed, seg_len=bucket, **akw,
+                )
+                self.n_splices += 1
+                self.prefix_hit_tokens += depth
+                # the splice is dispatched; its computation holds its
+                # own references, so the donor unpins at the SAME
+                # boundary a monolithic engine's completion would
+                self.prefix.release(segment)
+                segment = None
+            else:
+                padded = prompt + [0] * (bucket - p_len)
+                tokens = jnp.asarray([padded], jnp.int32)
+                seg, first, key = self._handoff_prefill(
+                    self.params, tokens, p_len, req.seed, **akw,
+                )
+                self.n_prefills += 1
+            if grow:
+                self.prefix.insert(tuple(pkey), seg, self._nbytes(seg))
+        except Exception:
+            if segment is not None:
+                self.prefix.release(segment)
+            self.n_prefill_errors += 1
+            if self._flight is not None:
+                self._flight.fault(
+                    "prefill_error", rid=req.request_id, slot=slot
+                )
+            return [self._complete_unstarted(req, "error")]
+        return self._emit_handoff(req, seg, first, key, p_len, bucket)
+
+    def _emit_handoff(self, req: Request, seg, first, key, p_len: int,
+                      bucket: int) -> list[Completion]:
+        """Park a finished prefill in the outgoing handoff map and
+        complete the request ``"handoff"`` — the router (or any
+        caller) collects the record via :meth:`take_handoff`. Host
+        bookkeeping only; every field stays a device future."""
+        self._handoffs[req.request_id] = Handoff(
+            segment=seg, first=first, key=key, p_len=p_len,
+            bucket=bucket, aid=int(getattr(req, "adapter", 0)),
+            submitted_s=req.submitted_s,
+        )
+        self.n_handoffs_out += 1
+        if self._flight is not None:
+            self._flight.record(
+                "handoff_emit", rid=req.request_id, p_len=p_len
+            )
+        return [self._complete_unstarted(req, "handoff")]
+
+    def _accept_refill(self, slot: int, req: Request) -> list[Completion]:
+        """Decode-role refill: splice the request's transferred segment
+        into ``slot`` (:meth:`_accept_fn` / :meth:`_accept_paged_fn`)
+        and fetch the handoff's first token — THE one budgeted scalar
+        fetch of the disaggregated path (graftcheck ``fetch-budget``
+        names this function; the prefill side fetched nothing). The
+        decode-role budget is therefore chains + handoffs, and the
+        fleet budget stays the sum of per-role budgets. A failing
+        accept is isolated exactly like a raising prefill: pages
+        released, slot parked, ``"error"`` completion, the engine
+        keeps serving."""
+        h = self._handoff_in.pop(req.request_id)
+        pages: list[int] = []
+        p_len = h.p_len
+        try:
+            if self._chaos is not None:
+                chaos_lib.maybe_fail_prefill(self._chaos, req.request_id)
+            akw = {"aid": h.aid} if self._adapters else {}
+            prompt = [int(t) for t in req.prompt]
+            # bucket-padded prompt seeds the n-gram history (dead
+            # operand when speculation is off, like _splice_fn's)
+            full = jnp.asarray(
+                [prompt + [0] * (h.bucket - p_len)], jnp.int32
+            )
+            if self._paged:
+                n_alloc = self._pool.pages_needed(
+                    p_len + req.max_new_tokens
+                )
+                pages = self._pool.alloc(n_alloc)
+                row = jnp.asarray(
+                    pages + [self._pool_pages]
+                    * (self._pages_per_slot - n_alloc),
+                    jnp.int32,
+                )
+                self._state, first = self._accept_jit(
+                    self.params, self._state, h.segment, full, row,
+                    h.first, h.key, p_len, slot, req.max_new_tokens,
+                    **akw,
+                )
+            else:
+                self._state, first = self._accept_jit(
+                    self.params, self._state, h.segment, full,
+                    h.first, h.key, p_len, slot, req.max_new_tokens,
+                    **akw,
+                )
+            self.n_handoffs_in += 1
+            first = int(jax.device_get(first))  # the handoff's ONE fetch
+        except Exception:
+            if pages:
+                self._pool.release_all(pages)
+            self.n_prefill_errors += 1
+            if self._flight is not None:
+                self._flight.fault(
+                    "prefill_error", rid=req.request_id, slot=slot
+                )
+            if self._paged:
+                self._state = self._paged_park(self._state, slot)
+            else:
+                self._state["remaining"] = self._park(
+                    self._state["remaining"], slot
+                )
+            return [self._complete_unstarted(req, "error")]
+        return self._activate(
+            slot, req, first, None, 0, pages=pages, kind="handoff"
+        )
+
     def _insert_paged_segment(self, pkey: list[int], pages: list[int],
                               p_len: int) -> None:
         """Insert-on-prefill, paged flavor: the retained "segment" is the
@@ -1924,14 +2409,17 @@ class ServeEngine:
             act.pages = []
 
     def _activate(self, slot: int, req: Request, first: int, segment,
-                  cached_len: int, pages=None) -> list[Completion]:
+                  cached_len: int, pages=None,
+                  kind: str | None = None) -> list[Completion]:
         """Admit a just-prefilled request into the decode phase — the
         shared tail of :meth:`_refill` and a chunked prefill's final
         chunk. ``segment`` pins the splice donor until completion; an
         EOS / ``max_new == 1`` first token completes immediately and
         parks the slot (its device-side counter still shows budget).
         ``pages`` (paged engines) transfers the slot's page references
-        onto the active record — released whenever the slot parks."""
+        onto the active record — released whenever the slot parks.
+        ``kind`` overrides the flight-event classification (the
+        disaggregated accept path stamps ``"handoff"``)."""
         self.generated_tokens += 1
         act = _Active(req, first)
         if pages:
@@ -1942,7 +2430,8 @@ class ServeEngine:
             # the span's prefill_t is an honest first-token time
             self._flight.request_prefilled(
                 req.request_id, slot,
-                kind="splice" if segment is not None else "prefill",
+                kind=kind
+                or ("splice" if segment is not None else "prefill"),
                 cached_len=cached_len,
             )
         if segment is not None:
@@ -2090,6 +2579,30 @@ class ServeEngine:
                 [suffix + [0] * (f_bucket - rem)], jnp.int32
             )
             bucket = bucket_len(p_len, self.window)
+            if self._role == "prefill":
+                # disaggregated final chunk (ISSUE 18): extract the
+                # finished segment from the side cache and EMIT it —
+                # no slot splice, no fetch (the decode side fetches)
+                seg, first, key = self._handoff_final(
+                    self.params, pend.cache1, tokens, rem - 1,
+                    req.seed, seg_len=bucket, **akw,
+                )
+                self.n_chunks += 1
+                if pend.segment is not None:
+                    self.n_splices += 1
+                    self.prefix_hit_tokens += pend.depth
+                    self.prefix.release(pend.segment)
+                    pend.segment = None
+                else:
+                    self.n_prefills += 1
+                if pend.grow:
+                    self.prefix.insert(
+                        tuple(pend.pkey), seg, self._nbytes(seg)
+                    )
+                del self._pending[slot]
+                return self._emit_handoff(
+                    req, seg, first, key, p_len, bucket
+                )
             full = (
                 jnp.asarray(
                     [pend.prompt + [0] * (bucket - p_len)], jnp.int32
@@ -2309,7 +2822,11 @@ class ServeEngine:
     def _complete_unstarted(self, req: Request, reason: str) -> Completion:
         """A zero-token completion for a request bounced at a boundary
         before any device work (cancelled / deadline / adapter_evicted /
-        prefill error): zero fetches, zero tokens, synchronous."""
+        prefill error): zero fetches, zero tokens, synchronous. Drops
+        any accepted-but-unspliced handoff for the request (a decode
+        request cancelled while queued must not strand its transfer
+        record — the device futures are simply released)."""
+        self._handoff_in.pop(req.request_id, None)
         comp = Completion(
             request_id=req.request_id,
             prompt=[int(t) for t in req.prompt],
@@ -2547,9 +3064,23 @@ class ServeEngine:
             out["tp_hlo_ok"] = self._tp_audit["ok"]
         return out
 
+    def role_stats(self) -> dict[str, int | str]:
+        """Disaggregation fields for the receipt (ISSUE 18): the
+        engine's role (config — regress.py fingerprints ``role`` so
+        disaggregated and monolithic rounds never gate each other)
+        plus the handoff counters (outcomes, excluded from the
+        fingerprint). ``{"role": 0}`` when monolithic."""
+        if self._role is None:
+            return {"role": 0}
+        return {
+            "role": self._role,
+            "handoffs_out": self.n_handoffs_out,
+            "handoffs_in": self.n_handoffs_in,
+        }
+
     _STATS_PARTS = (
         "prefix", "spec", "adapters", "fault", "flight", "pipeline",
-        "pages", "tp",
+        "pages", "tp", "role",
     )
 
     def stats(self, *parts: str) -> dict[str, int | float]:
@@ -2576,6 +3107,7 @@ class ServeEngine:
             "pipeline": self.pipeline_stats,
             "pages": self.page_stats,
             "tp": self.tp_stats,
+            "role": self.role_stats,
         }
         out: dict[str, int | float] = {}
         for part in self._STATS_PARTS:

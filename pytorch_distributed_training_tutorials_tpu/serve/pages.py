@@ -18,7 +18,11 @@ Design rules (the engine's paged contracts lean on every one):
   scheduler's ``fits`` predicate); only a request that could never fit
   the whole pool raises :class:`PoolExhausted` at submit —
   backpressure is synchronous, like ``QueueFull``, never a mid-decode
-  failure.
+  failure. A disaggregated decode replica (ISSUE 18) lands handoff
+  segments through this same refill-time path: ``accept`` is admission,
+  the pages allocate when the handoff enters a slot, so a transferred
+  prefill prices identically to a local one (``hbm_high_water_bytes``
+  parity is pinned in tests/test_handoff.py).
 - **Refcounts implement prefix sharing.** A prefix-cache hit RETAINS
   the donor segment's fully-shared pages (refcount + 1 per reader)
   instead of copying the segment; the first divergent write goes to a

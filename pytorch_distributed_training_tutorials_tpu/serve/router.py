@@ -69,6 +69,26 @@ Router-off parity: an N=1 router with hedging off is pure plumbing —
 global ids coincide with the single engine's local ids, completions,
 state trees, and compiled-program counts are byte-identical to driving
 the engine directly (tests/test_serve.py pins it).
+
+Role-aware dispatch (ISSUE 18): a fleet mixing ``role="prefill"`` and
+``role="decode"`` engines disaggregates the two phases. Submissions
+ride the SAME affinity ring restricted to prefill replicas (each
+prefill replica's prefix cache still sees a coherent key population);
+a prefill replica finishing a request emits ``finish_reason ==
+"handoff"``, which the router ABSORBS (never delivers — the ledger
+entry stays open, holding the fleet non-idle), collects the
+:class:`..serve.scheduler.Handoff` via ``take_handoff``, and moves it
+to the least-``load`` HEALTHY decode replica via ``engine.accept`` —
+recorded in the ledger as a ``"handoff"`` dispatch, so exactly-once
+holds across the transfer: a decode replica dying with the request
+still QUEUED re-dispatches the pristine template through the prefill
+ring (per-seed determinism makes the re-prefill token-identical), one
+dying mid-decode synthesizes ``replica_dead``, and a duplicate handoff
+from a hedged prefill is absorbed and dropped. Dead decode replicas
+half-open by receiving the next pending handoff as their probe (the
+submission-side probe path cannot reach them — ``submit`` on a decode
+engine raises). Roles must be all-or-nothing with at least one of
+each; monolithic fleets take the pre-ISSUE-18 code paths untouched.
 """
 
 from __future__ import annotations
@@ -127,7 +147,9 @@ def affinity_hash(prompt, adapter: int = 0, depth: int = 16) -> int:
 class LedgerEntry:
     """One accepted request's dispatch history. ``dispatches`` holds
     ``(replica, local_rid, kind, t)`` rows — kind is "dispatch" |
-    "redispatch" | "hedge" | "probe"; ``delivered`` is the finish_reason
+    "redispatch" | "hedge" | "probe" | "handoff" (a prefill-role
+    replica's finished segment moved onto a decode replica, ISSUE 18);
+    ``delivered`` is the finish_reason
     of the ONE completion handed to the caller (None while open);
     ``absorbed`` records completions the router swallowed (hedge losers,
     drain-path cancellations) as ``(replica, local_rid, reason)``."""
@@ -221,7 +243,7 @@ class _Replica:
     router gids — a dispatch is LIVE while its pair is present here."""
 
     __slots__ = (
-        "index", "engine", "state", "heartbeat", "last_sig",
+        "index", "engine", "role", "state", "heartbeat", "last_sig",
         "last_faults", "fault_streak", "queue_full_streak",
         "dead_since", "dead_reason", "probing", "probe_gid",
         "stall_skips", "local_gid",
@@ -230,6 +252,9 @@ class _Replica:
     def __init__(self, index: int, engine: Any):
         self.index = index
         self.engine = engine
+        # disaggregation role (ISSUE 18): None = monolithic,
+        # "prefill" / "decode" = the role-specialized halves
+        self.role = getattr(engine, "role", None)
         self.state = HEALTHY
         self.heartbeat: Optional[float] = None
         self.last_sig: Optional[tuple] = None
@@ -323,6 +348,23 @@ class FleetRouter:
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
         self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        roles = [r.role for r in self._replicas]
+        self._disagg = any(r is not None for r in roles)
+        if self._disagg:
+            # roles are all-or-nothing: a monolithic replica in a
+            # disaggregated fleet would race the handoff path for the
+            # same requests, and a fleet missing either role can never
+            # complete one
+            if any(r is None for r in roles):
+                raise ValueError(
+                    "mixed fleet: every engine must carry a role when "
+                    f"any does (roles={roles})"
+                )
+            if "prefill" not in roles or "decode" not in roles:
+                raise ValueError(
+                    "disaggregated fleet needs at least one prefill "
+                    f"AND one decode replica (roles={roles})"
+                )
         self._affinity_depth = int(affinity_depth)
         self._hedge_after_s = hedge_after_s
         self._suspect_after_s = float(suspect_after_s)
@@ -345,6 +387,16 @@ class FleetRouter:
         self.n_probes = 0
         self.n_dead_completions = 0
         self.n_health_transitions = 0
+        # disaggregation state (ISSUE 18): handoffs collected from
+        # prefill replicas awaiting a decode replica, gids whose
+        # handoff was already staged/placed (a hedged prefill's
+        # duplicate emit is absorbed, never staged twice), and gids
+        # cancelled while their handoff waits (delivered "cancelled"
+        # at the next move round — the chain-boundary contract).
+        self._pending_handoffs: List[Tuple[int, Any]] = []
+        self._handoff_done: set = set()
+        self._cancelled_gids: set = set()
+        self.n_handoffs_moved = 0
 
     # -- introspection -----------------------------------------------------
 
@@ -389,7 +441,7 @@ class FleetRouter:
             raise QueueClosed("fleet router is closed")
         template = dataclasses.replace(request)
         now = self._clock()
-        probe = self._probe_candidate(now)
+        probe = self._probe_candidate(now, role="prefill")
         order = ([probe] if probe is not None else []) + self._route_order(
             template
         )
@@ -430,24 +482,35 @@ class FleetRouter:
     def _route_order(self, request: Request) -> List[_Replica]:
         """The affinity ring from the request's hash: healthy replicas
         in ring order, then suspect ones (still serving, just avoided).
-        Dead and draining replicas take no new traffic."""
+        Dead and draining replicas take no new traffic. Disaggregated
+        fleets restrict the ring to PREFILL replicas (ISSUE 18):
+        submissions — and re-dispatches after a decode death, which
+        re-run the prefill — always enter through the prefill side;
+        decode replicas receive work only via :meth:`_move_handoffs`."""
         h = affinity_hash(
             request.prompt, adapter=int(getattr(request, "adapter", 0)),
             depth=self._affinity_depth,
         )
         n = len(self._replicas)
         ring = [self._replicas[(h + k) % n] for k in range(n)]
+        if self._disagg:
+            ring = [r for r in ring if r.role == "prefill"]
         return (
             [r for r in ring if r.state == HEALTHY]
             + [r for r in ring if r.state == SUSPECT]
         )
 
-    def _probe_candidate(self, now: float) -> Optional[_Replica]:
-        """First dead replica whose circuit-breaker rest expired and has
-        no probe outstanding — the half-open state. The next submission
-        becomes its probe; exactly-once machinery makes the gamble safe
-        (a failed probe's request is re-dispatched like any other)."""
+    def _probe_candidate(self, now: float,
+                         role: Optional[str] = None) -> Optional[_Replica]:
+        """First dead replica (of ``role``, when disaggregated) whose
+        circuit-breaker rest expired and has no probe outstanding — the
+        half-open state. The next submission (prefill/monolithic) or
+        pending handoff (decode) becomes its probe; exactly-once
+        machinery makes the gamble safe (a failed probe's request is
+        re-dispatched like any other)."""
         for rep in self._replicas:
+            if self._disagg and rep.role != role:
+                continue
             if (rep.state == DEAD and not rep.probing
                     and rep.dead_since is not None
                     and now - rep.dead_since >= self._probe_after_s):
@@ -499,6 +562,8 @@ class FleetRouter:
             self._observe(rep, self._clock())
         now = self._clock()
         out.extend(self._resolve_dead(now))
+        if self._disagg:
+            out.extend(self._move_handoffs(now))
         self._maybe_hedge(now)
         return out
 
@@ -537,6 +602,12 @@ class FleetRouter:
         entry = self.ledger.entries.get(gid)
         if entry is None or entry.delivered is not None:
             return False
+        if any(g == gid for g, _ in self._pending_handoffs):
+            # cancelled between prefill and decode (ISSUE 18): no
+            # engine holds it — the next _move_handoffs round delivers
+            # "cancelled" (that round IS this request's chain boundary)
+            self._cancelled_gids.add(gid)
+            return True
         any_known = False
         for rep_i, local, _, _ in entry.dispatches:
             rep = self._replicas[rep_i]
@@ -552,7 +623,10 @@ class FleetRouter:
         backpressure on later submits); accepted work is unaffected."""
         self._closed = True
         for rep in self._replicas:
-            if rep.state != DEAD:
+            # decode replicas must keep ADMITTING during a drain: their
+            # intake is accepted work's handoffs, not new requests —
+            # the router's own closed flag is the fleet admission stop
+            if rep.state != DEAD and rep.role != "decode":
                 try:
                     rep.engine.close()
                 except Exception:
@@ -624,6 +698,25 @@ class FleetRouter:
                     gid, rep.index, c.request_id, c.finish_reason
                 )
                 continue
+            if c.finish_reason == "handoff":
+                # a prefill replica finished its half (ISSUE 18): the
+                # completion is ABSORBED — the ledger entry stays open
+                # (holding the fleet non-idle) until the decode side
+                # delivers. The segment moves at this round's
+                # _move_handoffs; a duplicate emit from a hedged
+                # prefill is collected (the emitter's map must drain)
+                # but dropped.
+                self.ledger.absorbed(
+                    gid, rep.index, c.request_id, "handoff"
+                )
+                handoff = rep.engine.take_handoff(c.request_id)
+                if rep.probing and gid == rep.probe_gid:
+                    self._resolve_probe(rep, "handoff", now)
+                if (gid not in self._handoff_done
+                        and self.ledger.entries[gid].delivered is None):
+                    self._handoff_done.add(gid)
+                    self._pending_handoffs.append((gid, handoff))
+                continue
             entry = self.ledger.entries[gid]
             if entry.delivered is not None:
                 # hedge race: the other replica already won
@@ -655,7 +748,9 @@ class FleetRouter:
                        now: float) -> None:
         rep.probing = False
         rep.probe_gid = None
-        if reason in ("length", "eos"):
+        # "handoff" is the prefill-role success outcome (ISSUE 18):
+        # monolithic/decode replicas never emit it
+        if reason in ("length", "eos", "handoff"):
             rep.fault_streak = 0
             rep.queue_full_streak = 0
             rep.heartbeat = now
@@ -748,6 +843,12 @@ class FleetRouter:
                     pass
                 del rep.local_gid[local]
                 self._router_cancelled.add((rep.index, local))
+                if rep.role == "decode":
+                    # the transferred segment died with the replica: a
+                    # re-dispatch re-runs the PREFILL (the ring is the
+                    # prefill subset), whose fresh handoff must be
+                    # allowed to stage again
+                    self._handoff_done.discard(gid)
                 entry = self.ledger.entries[gid]
                 if entry.delivered is not None:
                     continue  # hedge twin already completed it
@@ -807,12 +908,82 @@ class FleetRouter:
             return rep
         return None
 
+    # -- handoff movement (ISSUE 18) ---------------------------------------
+
+    def _move_handoffs(self, now: float) -> List[Completion]:
+        """Move each pending handoff onto the least-``load`` HEALTHY
+        decode replica via ``engine.accept`` — a ``"handoff"`` ledger
+        dispatch, so exactly-once spans the transfer. A gid cancelled
+        while its handoff waited delivers ``"cancelled"`` here (the
+        handoff's chain boundary); a fleet with no admitting decode
+        replica keeps the handoff pending — retried every round, and
+        the open ledger entry keeps the fleet non-idle. A rested dead
+        decode replica takes the first moved handoff as its half-open
+        probe (delivery heals it, any fault re-opens the circuit)."""
+        out: List[Completion] = []
+        if not self._pending_handoffs:
+            return out
+        still: List[Tuple[int, Any]] = []
+        probe = self._probe_candidate(now, role="decode")
+        for gid, handoff in self._pending_handoffs:
+            template = self._requests[gid]
+            if gid in self._cancelled_gids:
+                self._cancelled_gids.discard(gid)
+                self._handoff_done.discard(gid)
+                self.ledger.delivered(gid, -1, "cancelled")
+                out.append(Completion(
+                    request_id=gid, prompt=list(template.prompt),
+                    tokens=[], finish_reason="cancelled", latency_s=0.0,
+                ))
+                continue
+            targets = sorted(
+                (r for r in self._replicas
+                 if r.role == "decode" and r.state == HEALTHY),
+                key=lambda r: int(getattr(r.engine, "load", 0)),
+            )
+            if probe is not None:
+                targets.append(probe)  # last resort: the half-open gamble
+            placed = False
+            for rep in targets:
+                try:
+                    local = rep.engine.accept(
+                        dataclasses.replace(template), handoff
+                    )
+                except (QueueFull, QueueClosed, ValueError):
+                    continue
+                rep.local_gid[local] = gid
+                self.ledger.dispatched(
+                    gid, rep.index, local, "handoff", now
+                )
+                self.n_handoffs_moved += 1
+                self._record("handoff_move", gid=gid, to=rep.index)
+                if rep is probe:
+                    rep.probing = True
+                    rep.probe_gid = gid
+                    self.n_probes += 1
+                    probe = None
+                    self._record("replica_health", replica=rep.index,
+                                 frm=DEAD, to="probing",
+                                 reason="half_open")
+                placed = True
+                break
+            if not placed:
+                still.append((gid, handoff))
+        self._pending_handoffs = still
+        return out
+
     # -- hedging -----------------------------------------------------------
 
     def _maybe_hedge(self, now: float) -> None:
         if self._hedge_after_s is None:
             return
         for gid in self.ledger.open_ids():
+            if self._disagg and gid in self._handoff_done:
+                # past the handoff: a hedge would re-run the PREFILL
+                # (the ring is the prefill subset) whose duplicate emit
+                # is dropped — pure waste. Prefill-side stragglers
+                # (not yet handed off) still hedge normally.
+                continue
             entry = self.ledger.entries[gid]
             live = self._live_dispatches(entry)
             if len(live) != 1:
@@ -866,10 +1037,16 @@ class FleetRouter:
         health/ledger counters are OUTCOMES and deliberately stay out of
         the fingerprint, mirroring the chaos precedent."""
         states = self.replica_states()
+        roles = [r.role for r in self._replicas]
         return {
             "n_replicas": self.n_replicas,
             "hedge": float(self._hedge_after_s or 0.0),
             "affinity": self._affinity_depth,
+            # disaggregation geometry (ISSUE 18): config, fingerprinted
+            # by regress.py; 0/0 = monolithic fleet
+            "n_prefill_replicas": roles.count("prefill"),
+            "n_decode_replicas": roles.count("decode"),
+            "handoffs_moved": self.n_handoffs_moved,
             "replicas_dead": states.count(DEAD),
             "replicas_draining": states.count(DRAINING),
             "requests_accepted": len(self.ledger.entries),
@@ -896,6 +1073,11 @@ class FleetRouter:
         # fleet (one mesh geometry, one compiled program set) — summing
         # tp sizes or and-ing audit booleans would both lie
         "tp", "mesh_shape", "tp_collectives", "tp_hlo_ok",
+        # disaggregation (ISSUE 18): per-engine role is a string (the
+        # first replica's passes through — a heterogeneous fleet's
+        # geometry lives in router_stats' n_prefill/n_decode_replicas);
+        # the handoff counters below it stay counters and SUM
+        "role",
     })
     # Derived ratios: recomputed or dropped rather than summed.
     _RATIO_STAT_KEYS = frozenset({

@@ -75,6 +75,37 @@ class Request:
 
 
 @dataclasses.dataclass
+class Handoff:
+    """A finished prefill leaving a ``role="prefill"`` engine (ISSUE 18).
+
+    The disaggregation transfer record: ``segment`` is the extracted
+    batch-1 KV tree covering the prompt's whole pow2 bucket ``[0,
+    bucket)`` (:func:`..serve.slots.extract_segment` — the prefix-splice
+    machinery reused as a cache transplant), ``first`` the sampled
+    first token and ``key`` the request's post-sample PRNG stream. All
+    three stay DEVICE residents — unfetched futures; the prefill side
+    never syncs on them, and the decode side's ``accept`` splice
+    (:func:`..serve.slots.seed_cache` + ``write_slot``) reconstructs
+    the monolithic post-prefill slot state bitwise before fetching only
+    ``first`` (the one budgeted handoff fetch). This module stays
+    jax-free: the device fields are opaque ``Any`` handles it never
+    inspects.
+
+    ``submitted_s`` carries the PREFILL side's admission stamp so the
+    decode engine can restore it after its own scheduler re-stamps —
+    end-to-end latency and TTFT span the original submit, not the
+    transfer."""
+
+    segment: Any
+    first: Any
+    key: Any
+    p_len: int
+    bucket: int
+    aid: int = 0
+    submitted_s: float = 0.0
+
+
+@dataclasses.dataclass
 class Completion:
     """A finished request: ``tokens`` are the generated ids (prompt
     excluded, stop token included when ``finish_reason == "eos"``);
@@ -90,13 +121,19 @@ class Completion:
     ``"nonfinite"`` — the request drove logits to NaN/Inf and its slot
     was quarantined (tokens up to the poisoned step are kept);
     ``"error"`` — prefill raised and the request was isolated (zero
-    tokens; the engine keeps serving)."""
+    tokens; the engine keeps serving).
+
+    ``"handoff"`` (ISSUE 18) — a ``role="prefill"`` engine finished the
+    prompt's prefill and parked the result for transfer (zero tokens
+    HERE; collect the :class:`Handoff` via ``take_handoff`` and hand it
+    to a decode engine's ``accept`` — the decode side's completion
+    reports the generated tokens)."""
 
     request_id: int
     prompt: list[int]
     tokens: list[int]
     # "length" | "eos" | "adapter_evicted" | "deadline" | "cancelled"
-    # | "nonfinite" | "error"
+    # | "nonfinite" | "error" | "handoff"
     finish_reason: str
     latency_s: float
     ttft_s: float = 0.0

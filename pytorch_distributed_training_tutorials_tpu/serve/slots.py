@@ -255,7 +255,13 @@ def write_slot_paged(cache, prefill_cache, row, slot, p_len,
 def extract_segment(cache, seg_len: int, scan_layers: bool):
     """Cut the first ``seg_len`` sequence positions out of a batch-1
     prefilled ``cache`` tree — the retained prefix segment the radix
-    index (:mod:`.prefix`) keeps alive.
+    index (:mod:`.prefix`) keeps alive, and since ISSUE 18 also the
+    transfer payload of a prefill/decode handoff: a ``role="prefill"``
+    engine cuts the prompt's whole pow2 bucket here and ships it as
+    ``Handoff.segment`` (device resident, never fetched); the decode
+    replica's accept replays the :func:`seed_cache` + :func:`write_slot`
+    splice surgery, so the transplant is bitwise the monolithic
+    post-prefill slot state.
 
     ``seg_len`` is STATIC (a pow2 ``bucket_len`` of the prefix length):
     segment shapes come from the same bucket set prefill compiles

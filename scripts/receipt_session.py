@@ -5,10 +5,11 @@ documented but NOT taken (no tunnel window in those sessions): the
 fused train-step tail, the --server base arm, prefix splicing,
 speculation, multi-tenant adapters, deadlines, the flight recorder,
 request-loop pipelining, the fleet router, the paged KV pool,
-tensor-parallel serving, and now the fused paged-attention kernel with
-int4 KV. This script is the catch-up: it sequences all twelve arms so
-the next session with a chip runs ONE command instead of re-deriving
-twelve recipes from CLAUDE.md prose.
+tensor-parallel serving, the fused paged-attention kernel with int4
+KV, and now prefill/decode disaggregation. This script is the
+catch-up: it sequences all thirteen arms so the next session with a
+chip runs ONE command instead of re-deriving thirteen recipes from
+CLAUDE.md prose.
 
 Sequencing is the point — every serving arm shares one --ckpt_dir, so
 the ~10-min cold 1.2B quantize-on-load cost is paid exactly once (by
@@ -53,6 +54,7 @@ ARM_NAMES = (
     "paged",       # --paged @ 4096 window: hbm_high_water_bytes claim
     "paged_int4",  # --kv-bits 4 --paged-kernel: 2x pages, fused reads
     "tp",          # --tp 4: head-sharded decode, per-chip KV at 1/tp
+    "disagg",      # --disaggregate 1p2d: role-split fleet, handoff TTFT
 )
 
 
@@ -113,6 +115,13 @@ def build_session(round_no: int, ckpt_dir: str, out_dir: str):
         # the interesting fields are tp_kv_bytes_per_chip (1/tp of the
         # global cache) and tp_hlo_ok at tok/s within a few % of base
         srv("tp", "--tp", "4"),
+        # disaggregated arm (ISSUE 18): one prefill-specialized replica
+        # feeds two decode-specialized replicas through device-side KV
+        # handoffs; the interesting fields are ttft_p95 under mixed
+        # long/short traffic (prefill no longer steals decode rounds),
+        # handoffs_moved == requests, and ledger_ok=true — decode tok/s
+        # itself should match the fleet arm
+        srv("disagg", "--disaggregate", "1p2d", "--qps", "8"),
     ]
 
 

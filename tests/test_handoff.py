@@ -1,0 +1,257 @@
+"""The device-side KV handoff (ISSUE 18): extract -> transfer -> accept.
+
+tests/test_serve.py pins the disaggregated FLEET (token-exactness, the
+split fetch budget, role validation); this file pins the transfer
+RECORD itself — the ``Handoff`` a ``role="prefill"`` engine emits and a
+``role="decode"`` engine splices:
+
+- the round trip is BITWISE: a decode engine fed handoffs lands on a
+  slot-state tree byte-identical to the monolithic engine that prefilled
+  the same requests itself — across the unrolled, ``scan_layers``, and
+  int8-KV cache layouts (nothing is recomputed in the splice, so even
+  quantized near-ties survive the move);
+- segment pricing is honest: an int4-KV segment's cache leaves cost
+  EXACTLY half the int8 segment's (packed nibbles + bf16 scales vs int8
+  + f32 scales — the ISSUE 17 identity), with only the unsliced
+  ``cache_index`` dead-weight leaves keeping the total above half;
+- a paged decode engine lands segments through the page pool: same
+  tokens and the same ``hbm_high_water_bytes`` as the monolithic paged
+  engine, and the pool drains back to zero when the stream completes;
+- under tensor-parallel serving the segment's KV leaves travel
+  HEAD-SHARDED (``SLOT_STATE_RULES`` applies to the extracted batch-1
+  tree too), ``tree_nbytes_sharded`` prices them at 1/tp, and the
+  sharded disaggregated pair stays token-exact to the replicated one.
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    TP_RULES,
+    TransformerConfig,
+    TransformerLM,
+)
+from pytorch_distributed_training_tutorials_tpu.serve import (
+    Request,
+    ServeEngine,
+)
+from pytorch_distributed_training_tutorials_tpu.serve import slots as slots_lib
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, max_seq_len=64
+)
+
+
+def _make(cfg=CFG, seed=0):
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def _prompt(seed, p_len, vocab=CFG.vocab_size):
+    return jax.device_get(
+        jax.random.randint(jax.random.PRNGKey(seed), (p_len,), 0, vocab)
+    ).tolist()
+
+
+def _tree_identical(a, b):
+    fa, ta = jax.tree_util.tree_flatten(a)
+    fb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb:
+        return False
+    return all(
+        x.shape == y.shape and x.dtype == y.dtype
+        and bool(jnp.all(x == y))
+        for x, y in zip(fa, fb)
+    )
+
+
+def _drive_pair(pre, dec, templates):
+    """The router-less disaggregated drive: prefill every template, move
+    each Handoff by hand in submit order, then run the decode engine to
+    idle. Returns completions in submit order."""
+    rids = [pre.submit(dataclasses.replace(t)) for t in templates]
+    pre.run_until_idle()
+    aids = [dec.accept(t, pre.take_handoff(r))
+            for t, r in zip(templates, rids)]
+    done = {c.request_id: c for c in dec.run_until_idle()}
+    return [done[a] for a in aids]
+
+
+def _templates(seed0, specs):
+    return [Request(prompt=_prompt(seed0 + i, p), max_new_tokens=m, seed=i)
+            for i, (p, m) in enumerate(specs)]
+
+
+# --------------------------------------------------- the bitwise round trip
+
+@pytest.mark.parametrize(
+    "cfg_kwargs",
+    [
+        dict(),
+        pytest.param(dict(scan_layers=True), marks=pytest.mark.slow),
+        pytest.param(dict(kv_cache_dtype="int8"), marks=pytest.mark.slow),
+    ],
+    ids=["unrolled", "scan_layers", "int8_kv"],
+)
+def test_handoff_roundtrip_state_bitwise(cfg_kwargs):
+    """The transfer is a transplant, not a re-derivation: after serving
+    the same requests in the same order, the decode engine's slot-state
+    tree is BYTE-identical to the monolithic engine's — extract_segment
+    carried the full post-prefill bucket and seed_cache + write_slot
+    rebuilt exactly what the monolithic refill would have computed
+    (valid even for quantized caches: nothing is recomputed, so int8's
+    rounded values move verbatim)."""
+    cfg = dataclasses.replace(CFG, **cfg_kwargs)
+    model, params = _make(cfg)
+    templates = _templates(9000, [(4, 9), (9, 7), (13, 11)])
+
+    mono = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    ids = [mono.submit(dataclasses.replace(t)) for t in templates]
+    ref = {c.request_id: c for c in mono.run_until_idle()}
+
+    pre = ServeEngine(model, params, role="prefill", n_slots=2,
+                      tokens_per_launch=8)
+    dec = ServeEngine(model, params, role="decode", n_slots=2,
+                      tokens_per_launch=8)
+    out = _drive_pair(pre, dec, templates)
+
+    assert [c.tokens for c in out] == [ref[i].tokens for i in ids]
+    assert _tree_identical(dec._state, mono._state)
+    # and the prefill engine never decoded: zero chains, all handoffs
+    assert pre.n_chains == 0 and pre.n_handoffs_out == len(templates)
+
+
+# ------------------------------------------------------- segment pricing
+
+def _kv_bytes(tree) -> int:
+    """Segment cache bytes EXCLUDING the unsliced cache_index dead
+    weight (extract_segment passes those leaves through whole; the
+    decode side's seed_cache overwrites them with the splice depth)."""
+    total = 0
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        if "cache_index" in jax.tree_util.keystr(kp):
+            continue
+        total += math.prod(leaf.shape) * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+def test_handoff_segment_pricing_int4_vs_int8():
+    """The wire cost of a handoff prices like the page pool does
+    (ISSUE 17's identity): int4's packed-nibble + bf16-scale leaves are
+    EXACTLY half int8's int8 + f32-scale leaves per token-head, so the
+    extracted segment's cache bytes halve exactly; only the unsliced
+    ``cache_index`` int32s (dead weight the accept overwrites) keep the
+    total tree above half."""
+    tmpl = Request(prompt=_prompt(9100, 11), max_new_tokens=4, seed=0)
+    segs = {}
+    for bits in ("int8", "int4"):
+        cfg = dataclasses.replace(CFG, kv_cache_dtype=bits)
+        model, params = _make(cfg)
+        pre = ServeEngine(model, params, role="prefill", n_slots=1,
+                          tokens_per_launch=8)
+        rid = pre.submit(dataclasses.replace(tmpl))
+        (comp,) = pre.run_until_idle()
+        assert comp.finish_reason == "handoff" and comp.tokens == []
+        segs[bits] = pre.take_handoff(rid)
+    h8, h4 = segs["int8"], segs["int4"]
+    assert h8.p_len == h4.p_len and h8.bucket == h4.bucket
+    assert _kv_bytes(h4.segment) * 2 == _kv_bytes(h8.segment)
+    total8 = slots_lib.tree_nbytes(h8.segment)
+    total4 = slots_lib.tree_nbytes(h4.segment)
+    assert total8 // 2 < total4 < total8
+
+
+# ----------------------------------------------------- paged decode accept
+
+def test_handoff_paged_decode_accept():
+    """A paged decode engine lands handoff segments through the pool:
+    pages allocate at accept (never mid-decode), the stream is
+    token-exact to the monolithic paged engine with the SAME
+    ``hbm_high_water_bytes`` (the accept allocates exactly what the
+    monolithic prefill-refill would have), and the pool drains back to
+    zero when every request completes."""
+    model, params = _make()
+    geometry = dict(paged=True, page_size=8, pool_pages=6)
+    templates = _templates(9200, [(4, 9), (9, 7), (13, 11)])
+
+    mono = ServeEngine(model, params, n_slots=2, tokens_per_launch=8,
+                       **geometry)
+    ids = [mono.submit(dataclasses.replace(t)) for t in templates]
+    ref = {c.request_id: c for c in mono.run_until_idle()}
+
+    pre = ServeEngine(model, params, role="prefill", n_slots=2,
+                      tokens_per_launch=8)
+    dec = ServeEngine(model, params, role="decode", n_slots=2,
+                      tokens_per_launch=8, **geometry)
+    out = _drive_pair(pre, dec, templates)
+    assert [c.tokens for c in out] == [ref[i].tokens for i in ids]
+
+    sd, sm = dec.page_stats(), mono.page_stats()
+    assert sd["paged"] == 1 and sd["pages_allocs"] > 0
+    assert sd["hbm_high_water_bytes"] == sm["hbm_high_water_bytes"]
+    assert sd["pages_in_use"] == 0  # drained: every page freed at finish
+
+
+# -------------------------------------------------- tensor-parallel handoff
+
+@pytest.mark.slow
+def test_handoff_tp_sharded_segment():
+    """Under tp=2 the handoff's segment travels head-sharded: the
+    extracted KV leaves resolve to the SLOT_STATE_RULES placement (kv
+    heads split on the model axis), ``tree_nbytes_sharded`` prices the
+    transfer at roughly 1/tp of global bytes, and the sharded
+    disaggregated pair decodes token-exact to the replicated one —
+    the handoff never forces a reshard."""
+    from pytorch_distributed_training_tutorials_tpu.parallel import (
+        TensorParallel,
+    )
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import (
+        create_mesh,
+    )
+
+    model, params = _make()
+    templates = _templates(9300, [(5, 8), (11, 6)])
+
+    # replicated disaggregated reference
+    pre_r = ServeEngine(model, params, role="prefill", n_slots=2,
+                        tokens_per_launch=8)
+    dec_r = ServeEngine(model, params, role="decode", n_slots=2,
+                        tokens_per_launch=8)
+    ref = _drive_pair(pre_r, dec_r, templates)
+
+    def _tp():
+        return TensorParallel(create_mesh({"model": 2}), TP_RULES)
+
+    pre = ServeEngine(model, params, role="prefill", n_slots=2,
+                      tokens_per_launch=8, strategy=_tp())
+    dec = ServeEngine(model, params, role="decode", n_slots=2,
+                      tokens_per_launch=8, strategy=_tp())
+
+    # inspect one handoff in flight before moving it
+    rid0 = pre.submit(dataclasses.replace(templates[0]))
+    pre.run_until_idle()
+    h = pre.take_handoff(rid0)
+    kv = [leaf for kp, leaf in jax.tree_util.tree_leaves_with_path(h.segment)
+          if jax.tree_util.keystr(kp).endswith("cached_key']")]
+    assert kv, "segment has no cached_key leaf"
+    for leaf in kv:
+        shard = leaf.sharding.shard_shape(leaf.shape)
+        # kv-head axis (second-to-last) halves; everything else intact
+        assert shard[-2] * 2 == leaf.shape[-2]
+        assert shard[-1] == leaf.shape[-1]
+    assert slots_lib.tree_nbytes_sharded(h.segment) \
+        < slots_lib.tree_nbytes(h.segment)
+
+    a0 = dec.accept(templates[0], h)
+    rid1 = pre.submit(dataclasses.replace(templates[1]))
+    pre.run_until_idle()
+    a1 = dec.accept(templates[1], pre.take_handoff(rid1))
+    done = {c.request_id: c for c in dec.run_until_idle()}
+    assert [done[a0].tokens, done[a1].tokens] == [c.tokens for c in ref]

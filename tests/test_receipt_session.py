@@ -1,7 +1,7 @@
 """scripts/receipt_session.py builds the deferred-receipt runbook.
 
 The script's job is sequencing, not measuring — so the CPU pin is that
-it builds exactly the twelve documented recipes (CLAUDE.md's "receipt
+it builds exactly the thirteen documented recipes (CLAUDE.md's "receipt
 has NOT been taken yet" list) with one shared checkpoint dir and
 round-stamped output names, without importing jax or needing a chip.
 """
@@ -26,11 +26,11 @@ def _load():
     return mod
 
 
-def test_plan_covers_all_twelve_deferred_arms():
+def test_plan_covers_all_thirteen_deferred_arms():
     mod = _load()
     plan = mod.build_session(6, "/ckpt", "/out")
     names = [n for n, _ in plan]
-    assert names == list(mod.ARM_NAMES) and len(names) == 12
+    assert names == list(mod.ARM_NAMES) and len(names) == 13
 
     cmds = dict(plan)
     # every serving arm shares the ONE checkpoint cache and is a
@@ -73,6 +73,11 @@ def test_plan_covers_all_twelve_deferred_arms():
     assert pi4[pi4.index("--max_seq_len") + 1] == "4096"
     # the tp arm is the head-sharded decode recipe (ISSUE 15)
     assert cmds["tp"][cmds["tp"].index("--tp") + 1] == "4"
+    # the disaggregated arm (ISSUE 18): role-split fleet, one prefill
+    # replica feeding two decode replicas under open-loop load
+    dg = cmds["disagg"]
+    assert dg[dg.index("--disaggregate") + 1] == "1p2d"
+    assert dg[dg.index("--qps") + 1] == "8"
 
 
 def test_only_filter_and_unknown_arm():
@@ -91,8 +96,9 @@ def test_dry_run_subprocess_prints_plan_without_running():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [ln for ln in out.stdout.splitlines() if ln.startswith("[")]
-    assert len(lines) == 12
+    assert len(lines) == 13
     assert any("SERVING_r99_tp.json" in ln for ln in lines)
+    assert any("SERVING_r99_disagg.json" in ln for ln in lines)
     assert any("SERVING_r99_paged.json" in ln for ln in lines)
     assert any("SERVING_r99_paged_int4.json" in ln for ln in lines)
     assert any("TRAIN_LLM_r99_fused.json" in ln for ln in lines)

@@ -154,6 +154,32 @@ def test_flight_flag_splits_fingerprint(tmp_path):
     assert regress.main([str(tmp_path)]) == 0
 
 
+def test_role_geometry_splits_fingerprint(tmp_path):
+    """ISSUE 18: disaggregated and monolithic rounds are different
+    experiments — `role` and the fleet's prefill/decode replica counts
+    fingerprint, so a slower disaggregated round never fails against
+    monolithic history (and different geometries never gate each
+    other); the handoff counters stay out of the fingerprint."""
+    _write(tmp_path, "SERVING_r01.json",
+           {**SERVING_CFG, "decode_tok_per_s": 100.0})
+    _write(tmp_path, "SERVING_r02.json",
+           {**SERVING_CFG, "role": "prefill", "n_prefill_replicas": 1,
+            "n_decode_replicas": 2, "decode_tok_per_s": 60.0})
+    assert regress.main([str(tmp_path)]) == 0
+    # a different role geometry is yet another experiment
+    _write(tmp_path, "SERVING_r03.json",
+           {**SERVING_CFG, "role": "prefill", "n_prefill_replicas": 2,
+            "n_decode_replicas": 1, "decode_tok_per_s": 40.0})
+    assert regress.main([str(tmp_path)]) == 0
+    # handoff counters are outcomes: same geometry, more handoffs, a
+    # slower rate IS a regression
+    _write(tmp_path, "SERVING_r04.json",
+           {**SERVING_CFG, "role": "prefill", "n_prefill_replicas": 2,
+            "n_decode_replicas": 1, "handoffs_moved": 99,
+            "decode_tok_per_s": 20.0})
+    assert regress.main([str(tmp_path)]) == 1
+
+
 def test_bad_tolerance_is_usage_error(tmp_path):
     assert regress.main([str(tmp_path), "--tolerance", "1.5"]) == 2
 

@@ -716,3 +716,212 @@ def test_router_module_stays_graftcheck_clean():
     # zero findings TOTAL: not even suppressed ones (a jax-free module
     # must not need a single `# graftcheck: disable`)
     assert findings == [], [f"{f.rule}:{f.line}" for f in findings]
+
+
+# ------------------------------------------------ disaggregation (ISSUE 18)
+
+class FakePrefillEngine(FakeEngine):
+    """Role-split prefill specialist: every queued request completes
+    immediately as a ``"handoff"`` whose payload carries the pristine
+    (prompt, seed, max_new) — the router moves it opaquely, exactly as
+    it moves the real engine's device-future Handoff."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.role = "prefill"
+        self._handoffs = {}
+        self.n_handoffs_out = 0
+
+    def take_handoff(self, rid):
+        return self._handoffs.pop(rid)
+
+    def step(self):
+        if self.raise_on_step:
+            raise RuntimeError("injected engine crash")
+        if self.frozen:
+            return []
+        out = []
+        for rid, req in list(self._queue):
+            if rid in self._cancelled:
+                self._queue.remove((rid, req))
+                out.append(Completion(
+                    request_id=rid, prompt=req.prompt, tokens=[],
+                    finish_reason="cancelled", latency_s=0.0,
+                ))
+        while self._queue:
+            rid, req = self._queue.pop(0)
+            self.n_prefills += 1
+            self.n_handoffs_out += 1
+            self._handoffs[rid] = {
+                "prompt": list(req.prompt), "seed": req.seed,
+                "max_new": req.max_new_tokens,
+            }
+            out.append(Completion(
+                request_id=rid, prompt=req.prompt, tokens=[],
+                finish_reason="handoff", latency_s=0.0,
+            ))
+        return out
+
+
+class FakeDecodeEngine(FakeEngine):
+    """Role-split decode specialist: admits work only via accept();
+    ``extra_load`` biases the router's least-loaded placement key."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.role = "decode"
+        self.n_handoffs_in = 0
+        self.extra_load = 0
+
+    def submit(self, request):
+        raise ValueError("role='decode' engines admit via accept()")
+
+    @property
+    def load(self):
+        return len(self._queue) + len(self._active) + self.extra_load
+
+    def accept(self, request, handoff):
+        if self.closed:
+            raise QueueClosed("closed")
+        if len(self._queue) >= self.max_queue:
+            raise QueueFull("full")
+        rid = self._next_id
+        self._next_id += 1
+        request.request_id = rid
+        self._queue.append((rid, request))
+        self.submitted.append(rid)
+        self.n_handoffs_in += 1
+        return rid
+
+
+def _disagg_fleet(n_pre=1, n_dec=2, clock=None, **kw):
+    engines = ([FakePrefillEngine() for _ in range(n_pre)]
+               + [FakeDecodeEngine() for _ in range(n_dec)])
+    router = FleetRouter(engines, clock=clock or FakeClock(), **kw)
+    return engines, router
+
+
+def test_disagg_fleet_role_validation():
+    """All-or-nothing roles: a mixed fleet (some engines monolithic,
+    some role-carrying) and a fleet missing either role are both
+    construction errors — a half-role fleet strands work."""
+    with pytest.raises(ValueError):
+        FleetRouter([FakeEngine(), FakePrefillEngine()], clock=FakeClock())
+    with pytest.raises(ValueError):
+        FleetRouter([FakePrefillEngine(), FakePrefillEngine()],
+                    clock=FakeClock())
+    with pytest.raises(ValueError):
+        FleetRouter([FakeDecodeEngine(), FakeDecodeEngine()],
+                    clock=FakeClock())
+
+
+def test_disagg_exactly_once_and_stats():
+    """The happy path: submits land ONLY on the prefill replica,
+    handoffs move to decode replicas as ledger-tracked "handoff"
+    dispatches, every request delivers exactly once with the
+    per-seed-deterministic stream, and the role geometry + handoff
+    counters land in router_stats."""
+    engines, router = _disagg_fleet(1, 2)
+    reqs = [_req(s, max_new=6) for s in range(6)]
+    gids = [router.submit(dataclasses.replace(r)) for r in reqs]
+    assert len(engines[0].submitted) == 6      # prefill got everything
+    done = {c.request_id: c for c in router.run_until_idle()}
+    for r, g in zip(reqs, gids):
+        assert done[g].finish_reason == "length"
+        assert done[g].tokens == _expected(r)
+    assert router.ledger.verify() == []
+    st = router.router_stats()
+    assert st["n_prefill_replicas"] == 1
+    assert st["n_decode_replicas"] == 2
+    assert st["handoffs_moved"] == 6
+    assert engines[0].n_handoffs_out == 6
+    assert sum(e.n_handoffs_in for e in engines[1:]) == 6
+
+
+def test_disagg_handoffs_go_to_least_loaded_decode():
+    engines, router = _disagg_fleet(1, 2)
+    _, d0, d1 = engines
+    d0.extra_load = 5
+    for s in range(3):
+        router.submit(_req(s, max_new=4))
+    router.run_until_idle()
+    # placement ignored affinity and followed load: everything avoided
+    # the loaded replica
+    assert d0.n_handoffs_in == 0 and d1.n_handoffs_in == 3
+    assert router.ledger.verify() == []
+
+
+def test_disagg_decode_death_reprefills_queued_exactly_once():
+    """A decode replica dying mid-stream: its in-flight request
+    completes ``replica_dead``, its QUEUED one re-enters through the
+    PREFILL side (the handoff-done guard is released so the fresh
+    handoff restages) and finishes token-identically on the surviving
+    decode replica — the ledger proving exactly-once across the whole
+    death."""
+    clock = FakeClock()
+    engines, router = _disagg_fleet(
+        1, 2, clock=clock, suspect_after_s=1.0, dead_after_s=3.0,
+    )
+    _, d0, d1 = engines
+    d0.n_slots = 1
+    d1.extra_load = 99          # both handoffs land on d0
+    r0, r1 = _req(0, max_new=6), _req(1, max_new=6)
+    g0 = router.submit(dataclasses.replace(r0))
+    g1 = router.submit(dataclasses.replace(r1))
+    router.step()               # prefill emits both; both move to d0
+    done = router.step()        # d0 starts r0; r1 queued behind it
+    assert d0.n_handoffs_in == 2
+    d0.frozen = True
+    clock.advance(1.5)
+    done += router.step()
+    assert router.replica_states()[1] == SUSPECT
+    clock.advance(2.0)
+    done += router.step()
+    assert router.replica_states()[1] == DEAD
+    done += router.run_until_idle()
+    by_gid = {c.request_id: c for c in done}
+    assert by_gid[g0].finish_reason == REPLICA_DEAD
+    assert by_gid[g1].finish_reason == "length"
+    assert by_gid[g1].tokens == _expected(r1)
+    assert d1.n_handoffs_in == 1          # the re-prefilled handoff
+    assert engines[0].n_handoffs_out == 3  # 2 original + 1 re-prefill
+    assert router.ledger.verify() == []
+
+
+def test_disagg_cancel_between_phases():
+    """A request cancelled AFTER its prefill finished but BEFORE any
+    decode replica admitted the handoff: no engine holds it, so the
+    next handoff-move round is its chain boundary — delivered
+    ``"cancelled"`` with zero decode work, exactly once."""
+    engines, router = _disagg_fleet(1, 1)
+    _, dec = engines
+    dec.max_queue = 0          # decode refuses: the handoff stays staged
+    r = _req(3)
+    gid = router.submit(dataclasses.replace(r))
+    router.step()              # prefill emits; placement bounces
+    assert router.cancel(gid)
+    dec.max_queue = 8
+    done = router.run_until_idle()
+    assert [c.request_id for c in done] == [gid]
+    assert done[0].finish_reason == "cancelled" and done[0].tokens == []
+    assert dec.n_handoffs_in == 0
+    assert router.ledger.verify() == []
+
+
+def test_disagg_drain_keeps_decode_admitting():
+    """close() stops FLEET admission but must NOT close decode
+    engines — accepted work still needs its handoffs admitted during
+    the drain, or the fleet deadlocks with segments in hand."""
+    engines, router = _disagg_fleet(1, 1)
+    reqs = [_req(s, max_new=4) for s in range(3)]
+    gids = [router.submit(dataclasses.replace(r)) for r in reqs]
+    router.close()
+    with pytest.raises(QueueClosed):
+        router.submit(_req(99))
+    done = router.drain()
+    by_gid = {c.request_id: c for c in done}
+    for r, g in zip(reqs, gids):
+        assert by_gid[g].finish_reason == "length"
+        assert by_gid[g].tokens == _expected(r)
+    assert not engines[1].closed   # the decode engine stayed open
+    assert router.ledger.verify() == []

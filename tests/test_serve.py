@@ -2742,3 +2742,215 @@ def test_serve_selftest_tp_subprocess(tmp_path):
     assert receipt["tp_kv_bytes_per_chip"] < receipt["tp_kv_bytes_global"]
     assert receipt["tp_host_fetches"] > 0
     assert load_receipt(json_path)["ok"] is True
+
+
+# ------------------------------------------------ disaggregation (ISSUE 18)
+
+def _disagg_fleet_run(model, params, reqs, pre_kw=None, dec_kw=None,
+                      **shared):
+    """Drive ``reqs`` = [(prompt, max_new, adapter), ...] through a
+    1 prefill + 1 decode role fleet; returns (pre, dec, router,
+    completions-in-submit-order)."""
+    from pytorch_distributed_training_tutorials_tpu.serve import FleetRouter
+
+    base = dict(n_slots=2, tokens_per_launch=8)
+    base.update(shared)
+    pre = ServeEngine(model, params, role="prefill",
+                      **{**base, **(pre_kw or {})})
+    dec = ServeEngine(model, params, role="decode",
+                      **{**base, **(dec_kw or {})})
+    fr = FleetRouter([pre, dec])
+    gids = [fr.submit(Request(prompt=p, max_new_tokens=m, adapter=a,
+                              seed=i))
+            for i, (p, m, a) in enumerate(reqs)]
+    done = {c.request_id: c for c in fr.run_until_idle()}
+    return pre, dec, fr, [done[g] for g in gids]
+
+
+def test_disagg_token_exact_mixed_lengths(model_params):
+    """The ISSUE 18 acceptance pin: a 1p+1d role fleet serves staggered
+    mixed-length greedy requests token-exact to one-shot generate() —
+    the device-side KV handoff (extract on the prefill replica, splice
+    surgery on the decode replica) is invisible in the tokens."""
+    model, params = model_params
+    reqs = [(_prompt(8000 + i, p), m, 0)
+            for i, (p, m) in enumerate([(3, 9), (7, 12), (12, 6), (5, 17)])]
+    pre, dec, fr, out = _disagg_fleet_run(model, params, reqs)
+    for (p, m, _), c in zip(reqs, out):
+        assert c.tokens == _reference(model, params, p, m)
+        assert c.finish_reason == "length"
+    # the split actually happened: every prefill ran on the prefill
+    # replica, every chain on the decode replica
+    assert pre.n_prefills == len(reqs) and pre.n_chains == 0
+    assert dec.n_prefills == 0 and dec.n_chains > 0
+    assert pre.n_handoffs_out == len(reqs)
+    assert dec.n_handoffs_in == len(reqs)
+    assert fr.ledger.verify() == []
+    st = fr.router_stats()
+    assert st["n_prefill_replicas"] == 1 and st["n_decode_replicas"] == 1
+    assert st["handoffs_moved"] == len(reqs)
+
+
+def test_disagg_fetch_budget(model_params, monkeypatch):
+    """The fleet fetch budget under disaggregation: the prefill role
+    fetches NOTHING (its handoff carries device futures), the decode
+    role fetches once per chain plus once per ACCEPTED handoff — so the
+    whole fleet's device_get count is exactly dec.n_chains +
+    dec.n_handoffs_in, with the prefill replica contributing zero."""
+    model, params = model_params
+    reqs = [(_prompt(8100 + i, 4 + 3 * i), 10, 0) for i in range(3)]
+    calls = {"n": 0}
+    real_get = jax.device_get
+    monkeypatch.setattr(
+        jax, "device_get",
+        lambda x: (calls.__setitem__("n", calls["n"] + 1), real_get(x))[1],
+    )
+    pre, dec, fr, out = _disagg_fleet_run(model, params, reqs)
+    assert len(out) == 3 and all(c.finish_reason == "length" for c in out)
+    assert dec.n_handoffs_in == 3
+    # every fetch in the run is accounted to the decode role: chains +
+    # handoffs. Nothing left for the prefill role to have spent.
+    assert calls["n"] == dec.n_chains + dec.n_handoffs_in
+    assert pre.n_prefills == 3 and pre.n_splices == 0
+
+
+def test_disagg_role_validation(model_params):
+    """Role construction rejects the other side's machinery, and the
+    role-specific entry points reject the wrong role — admission
+    failures are synchronous, never a mid-decode surprise."""
+    model, params = model_params
+    with pytest.raises(ValueError):
+        ServeEngine(model, params, role="tokenize")
+    for bad_kw in (dict(speculative_k=2), dict(pipeline_depth=2),
+                   _paged_geometry()):
+        with pytest.raises(ValueError):
+            ServeEngine(model, params, role="prefill", **bad_kw)
+    for bad_kw in (dict(prefix_cache_bytes=1 << 20),
+                   dict(prefill_chunk=8)):
+        with pytest.raises(ValueError):
+            ServeEngine(model, params, role="decode", **bad_kw)
+    pre = ServeEngine(model, params, role="prefill", n_slots=1)
+    dec = ServeEngine(model, params, role="decode", n_slots=1)
+    with pytest.raises(ValueError):
+        dec.submit(Request(prompt=[1, 2], max_new_tokens=2))
+    with pytest.raises(ValueError):
+        pre.accept(Request(prompt=[1, 2], max_new_tokens=2), None)
+    with pytest.raises(ValueError):
+        dec.take_handoff(0)
+
+
+def test_disagg_role_none_off_path(model_params):
+    """role=None is the monolithic engine: NO handoff programs are
+    constructed (compiled-program census unchanged), the handoff
+    counters stay zero through a served stream, and role_stats reports
+    the off marker."""
+    model, params = model_params
+    engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    assert engine.role is None
+    assert not hasattr(engine, "_handoff_prefill")
+    assert not hasattr(engine, "_accept_jit")
+    engine.submit(Request(prompt=_prompt(8200, 5), max_new_tokens=6))
+    engine.run_until_idle()
+    assert engine.n_handoffs_out == 0 and engine.n_handoffs_in == 0
+    assert engine.role_stats() == {"role": 0}
+    assert engine.stats("role") == {"role": 0}
+    with pytest.raises(ValueError):
+        engine.take_handoff(0)
+
+
+def test_disagg_direct_handoff_token_exact(model_params):
+    """The engine-level contract without a router: submit to the
+    prefill engine, move its Handoff into the decode engine by hand,
+    and the decoded stream still matches generate() — the handoff API
+    is complete on its own (heterogeneous fleets can drive it)."""
+    import dataclasses as _dc
+
+    model, params = model_params
+    pre = ServeEngine(model, params, role="prefill", n_slots=2,
+                      tokens_per_launch=8)
+    dec = ServeEngine(model, params, role="decode", n_slots=2,
+                      tokens_per_launch=8)
+    reqs = [(_prompt(8300 + i, p), m) for i, (p, m) in
+            enumerate([(4, 8), (9, 11)])]
+    for i, (p, m) in enumerate(reqs):
+        tmpl = Request(prompt=p, max_new_tokens=m, seed=i)
+        rid = pre.submit(_dc.replace(tmpl))
+        comps = pre.run_until_idle()
+        assert [c.finish_reason for c in comps] == ["handoff"]
+        assert comps[0].tokens == []
+        dec.accept(tmpl, pre.take_handoff(rid))
+    done = dec.run_until_idle()
+    assert sorted(len(c.tokens) for c in done) == sorted(
+        m for _, m in reqs
+    )
+    by_len = {len(c.tokens): c for c in done}
+    for p, m in reqs:
+        assert by_len[m].tokens == _reference(model, params, p, m)
+
+
+@pytest.mark.parametrize(
+    "cfg_kwargs",
+    [
+        pytest.param(dict(scan_layers=True), marks=pytest.mark.slow),
+        pytest.param(dict(n_kv_heads=2), marks=pytest.mark.slow),
+        pytest.param(dict(kv_cache_dtype="int8"), marks=pytest.mark.slow),
+    ],
+    ids=["scan_layers", "gqa", "int8_kv"],
+)
+def test_disagg_token_exact_layouts(cfg_kwargs):
+    """The handoff surgery on the variant cache layouts (scan-stacked,
+    GQA-shrunk, int8-quantized leaves + scales): disaggregated greedy
+    matches the MONOLITHIC engine token for token (int8's rounded
+    near-ties make engine-vs-engine the right oracle; the unrolled
+    full-precision arm pins generate()-exactness above)."""
+    import dataclasses as _dc
+
+    cfg = _dc.replace(CFG, **cfg_kwargs)
+    model, params = _make(cfg)
+    reqs = [(_prompt(8400 + i, p), m, 0)
+            for i, (p, m) in enumerate([(4, 9), (9, 7), (13, 11)])]
+    mono = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    ids = [mono.submit(Request(prompt=p, max_new_tokens=m, seed=i))
+           for i, (p, m, _) in enumerate(reqs)]
+    ref = {c.request_id: c for c in mono.run_until_idle()}
+    _, _, fr, out = _disagg_fleet_run(model, params, reqs)
+    assert [c.tokens for c in out] == [ref[i].tokens for i in ids]
+    assert fr.ledger.verify() == []
+
+
+@pytest.mark.slow
+def test_disagg_composed_full_stack(model_params):
+    """The everything-composed acceptance arm: prefill replica with
+    prefix cache + chunked prefill, decode replica with speculation +
+    paged KV + depth-2 pipelining, adapter banks on BOTH (the factors
+    act in prefill and decode forwards alike) — a mixed-tenant
+    shared-prefix stream is token-exact to one monolithic engine
+    running the same full stack, with the ledger proving exactly-once
+    across every handoff."""
+    model, params = model_params
+    shared = _prompt(8500, 12)
+    reqs = [(shared + _prompt(8501 + i, 5), 5 + (i % 3), i % 3)
+            for i in range(6)]
+    mono = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=8,
+        prefix_cache_bytes=16 * 1024 * 1024, prefill_chunk=8,
+        speculative_k=2, pipeline_depth=2,
+        adapter_bank=_lora_bank(model), **_paged_geometry(),
+    )
+    ids = [mono.submit(Request(prompt=p, max_new_tokens=m, adapter=a,
+                               seed=i))
+           for i, (p, m, a) in enumerate(reqs)]
+    ref = {c.request_id: c for c in mono.run_until_idle()}
+    pre, dec, fr, out = _disagg_fleet_run(
+        model, params, reqs,
+        pre_kw=dict(prefix_cache_bytes=16 * 1024 * 1024, prefill_chunk=8,
+                    adapter_bank=_lora_bank(model)),
+        dec_kw=dict(speculative_k=2, pipeline_depth=2,
+                    adapter_bank=_lora_bank(model), **_paged_geometry()),
+    )
+    assert [c.tokens for c in out] == [ref[i].tokens for i in ids]
+    # the composed machinery actually engaged on each side
+    assert pre.n_splices > 0          # shared prefix spliced on prefill
+    assert dec.page_stats()["paged"] == 1
+    assert fr.ledger.verify() == []
+    assert fr.router_stats()["handoffs_moved"] == len(reqs)
