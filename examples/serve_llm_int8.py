@@ -296,6 +296,16 @@ def main():
         "flag only adds the on-disk dump",
     )
     ap.add_argument(
+        "--no-sentry", action="store_true", dest="no_sentry",
+        help="for --server: disable the runtime contract sentry "
+        "(ISSUE 19). On by default — host-only counters watching the "
+        "zero-steady-recompile, fetch-budget, and no-host-numpy "
+        "contracts at runtime; a violation auto-dumps a flight "
+        "snapshot and the receipt carries sentry_* fields. regress.py "
+        "fingerprints `sentry`, so bare and instrumented rounds never "
+        "gate each other",
+    )
+    ap.add_argument(
         "--pipeline-depth", type=int, default=1, dest="pipeline_depth",
         help="for --server: decode chains kept in flight before the host "
         "fetches the oldest (serve.ServeEngine pipeline_depth; 1 = "
@@ -819,6 +829,18 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
         if args.disaggregate else (0, 0)
     )
 
+    # contract sentry (ISSUE 19): ONE sentry shared by every replica —
+    # compile/fetch hooks are process-global, and FleetRouter.stats()
+    # dedupes the shared instance by identity instead of summing it N
+    # times. It stamps into the ROUTER's recorder so violations land in
+    # the merged fleet dump. --no-sentry reverts to the bare fleet.
+    router_flight = FlightRecorder(capacity=4096, t0=t0)
+    sentry = None
+    if not args.no_sentry:
+        from pytorch_distributed_training_tutorials_tpu.obs import ContractSentry
+
+        sentry = ContractSentry(flight=router_flight).install()
+
     def mk_engine(role: str | None = None) -> ServeEngine:
         kw = dict(
             n_slots=args.slots,
@@ -835,6 +857,7 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
             pipeline_depth=args.pipeline_depth,
             prefill_chunk=args.prefill_chunk,
             flight=FlightRecorder(capacity=4096, t0=t0),
+            sentry=sentry,
             strategy=_serving_strategy(lm),
             **_paged_kwargs(args, window),
         )
@@ -867,7 +890,7 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
     router = FleetRouter(
         engines,
         hedge_after_s=args.hedge_after,
-        flight=FlightRecorder(capacity=4096, t0=t0),
+        flight=router_flight,
     )
     rng = np.random.Generator(np.random.PCG64(11))
     shared = rng.integers(0, cfg.vocab_size, (max(lengths),)).tolist()
@@ -915,6 +938,10 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
         eng._flight.reset()
     router.n_handoffs_moved = 0
     router._flight.reset()
+    if sentry is not None:
+        # same seam as the recorder resets: warmup compiles were legal,
+        # anything past here is a steady-state violation
+        sentry.mark_steady()
 
     # open-loop Poisson arrivals (qps > 0) or the up-front burst (0)
     arng = np.random.Generator(np.random.PCG64(17))
@@ -948,6 +975,8 @@ def serve_fleet_stream(args, cfg, lm, params, receipt: dict) -> None:
     wall_s = time.perf_counter() - t_start
 
     rstats = router.stats()
+    if sentry is not None:
+        sentry.uninstall()
     toks = sum(e.generated_tokens for e in engines)
     receipt.update(
         server=True,
@@ -1032,6 +1061,19 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     # snapshots (fault auto-dumps + one end-of-stream dump) to disk.
     flight = FlightRecorder(capacity=4096, dump_path=args.flight_log)
 
+    # contract sentry (ISSUE 19): on by default for every --server arm —
+    # host-only counters, zero extra device fetches — so the receipt
+    # carries sentry_steady_recompiles / sentry_fetch_budget_ok /
+    # sentry_reupload_bytes and a contract break on the real chip
+    # auto-dumps a flight snapshot instead of silently eating the round.
+    # --no-sentry reverts to the bare engine (regress.py fingerprints
+    # the `sentry` field, so the two never gate each other).
+    sentry = None
+    if not args.no_sentry:
+        from pytorch_distributed_training_tutorials_tpu.obs import ContractSentry
+
+        sentry = ContractSentry(flight=flight).install()
+
     bank = None
     if args.adapters:
         # multi-tenant arm: N-1 synthetic tenants (small random factors)
@@ -1083,6 +1125,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         adapter_bank=bank,
         default_deadline_s=args.deadline_s,
         flight=flight,
+        sentry=sentry,
         pipeline_depth=args.pipeline_depth,
         prefill_chunk=args.prefill_chunk,
         strategy=_serving_strategy(lm),
@@ -1133,6 +1176,10 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
     # the warmup's compile-dominated spans would poison the percentile
     # histograms — reset the recorder with the counters above
     flight.reset()
+    if sentry is not None:
+        # same seam: warmup compiles were legal and attributed; from
+        # here any compilation is a steady-state violation (auto-dumped)
+        sentry.mark_steady()
 
     t0 = time.perf_counter()
     for i in range(args.requests):
@@ -1211,6 +1258,13 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         prefix_note += (
             f", pipeline depth {ps['pipeline_depth']} "
             f"(chunk {ps['prefill_chunk']}, {ps['n_chunks']} chunks)"
+        )
+    if sentry is not None:
+        sentry.uninstall()
+        prefix_note += (
+            f", sentry: {sentry.n_steady_recompiles} steady recompiles, "
+            f"budget {'OK' if not sentry.n_budget_violations else 'OVER'}"
+            f", {sentry.reupload_bytes} B re-uploaded"
         )
     print(
         f"server: {args.requests} requests (prompts {lengths}, {new} new "

@@ -33,6 +33,7 @@ HOST_ONLY_MODULES: tuple[str, ...] = (
     f"{_PKG}.adapters.registry",
     f"{_PKG}.obs.flight",
     f"{_PKG}.obs.histogram",
+    f"{_PKG}.obs.sentry",
     f"{_PKG}.serve.pages",
     f"{_PKG}.serve.prefix",
     f"{_PKG}.serve.router",

@@ -145,6 +145,13 @@ CONFIG_FIELDS = (
     # handoff counters (handoffs_out/in/moved) stay out — outcomes of
     # the traffic, not configuration
     "role", "n_prefill_replicas", "n_decode_replicas",
+    # contract sentry (ISSUE 19): an instrumented round carries a
+    # jax.device_get wrapper + a compile listener in the request loop
+    # (host-only, but still instrumentation), so sentry-on and bare
+    # rounds never gate each other; the sentry's own counters
+    # (sentry_compiles, sentry_steady_recompiles, sentry_fetched,
+    # sentry_reupload_bytes, ...) stay out — outcomes, not configuration
+    "sentry",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

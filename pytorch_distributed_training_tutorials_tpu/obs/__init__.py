@@ -23,7 +23,12 @@ Plus the production twin of the benchmarking pillars (ISSUE 10):
   host-only and budget-neutral by contract;
 - :mod:`.histogram` — :class:`LogHistogram`: streaming log2 histograms
   with mergeable state and bounded-error p50/p95/p99 (the serving
-  percentile path — replaces sort-the-list).
+  percentile path — replaces sort-the-list);
+- :mod:`.sentry` — :class:`ContractSentry` (ISSUE 19): runtime monitor
+  for the three engine contracts — zero steady-state recompiles (JAX
+  compilation events), the serve fetch budget (the production twin of
+  the test monkeypatch spies), and no host-numpy re-uploads per
+  dispatch; violations announce as typed flight events + auto-dumps.
 
 ``python -m pytorch_distributed_training_tutorials_tpu.obs --selftest`` smoke-runs all four on a
 tiny CPU-mesh workload.
@@ -62,6 +67,7 @@ _LAZY_EXPORTS = {
     "summarize_merged": "pytorch_distributed_training_tutorials_tpu.obs.flight",
     "validate_flightlog": "pytorch_distributed_training_tutorials_tpu.obs.flight",
     "LogHistogram": "pytorch_distributed_training_tutorials_tpu.obs.histogram",
+    "ContractSentry": "pytorch_distributed_training_tutorials_tpu.obs.sentry",
 }
 
 __all__ = sorted(_LAZY_EXPORTS)

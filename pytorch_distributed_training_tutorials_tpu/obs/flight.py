@@ -78,10 +78,19 @@ EVENT_KINDS = frozenset({
     "handoff_emit",      # prefill-role engine finished a transferable prefill
     "handoff_move",      # router moved a KV segment to a decode replica
     "handoff_accept",    # decode-role engine spliced a handoff into a slot
+    "compile",           # contract sentry: one XLA compilation (ISSUE 19)
+    "budget_violation",  # contract sentry: round fetches exceeded budget
+    "reupload",          # contract sentry: host-numpy leaves in a dispatch
 })
 
-# Faults trigger an auto-dump when a dump_path is configured.
-_AUTO_DUMP_KINDS = frozenset({"fault", "step_skipped", "rollback"})
+# Faults trigger an auto-dump when a dump_path is configured. The two
+# sentry violation kinds (ISSUE 19) ride the same path — a budget or
+# re-upload violation IS a fault-class post-mortem; plain "compile"
+# events stay out (warmup compiles are normal; the sentry dumps a
+# POST-STEADY recompile explicitly, so warmup never floods the log).
+_AUTO_DUMP_KINDS = frozenset({
+    "fault", "step_skipped", "rollback", "budget_violation", "reupload",
+})
 
 
 class FlightRecorder:

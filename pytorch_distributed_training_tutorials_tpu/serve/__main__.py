@@ -87,6 +87,20 @@ KV slot state must REALLY shard (per-chip bytes strictly below global),
 and the compiled decode chain's HLO must pass the collective audit
 (``audit_decode_hlo`` — nothing beyond the whitelisted all-reduces).
 ``tp_*`` receipt fields carry the audit verdict and per-chip KV bytes.
+An eleventh (``--sentry``) arm runs the runtime contract sentry
+(ISSUE 19) — the production twin of this harness's own monkeypatch
+spies: a :class:`..obs.sentry.ContractSentry`-instrumented engine warms
+up the base stream, ``mark_steady()``s, then replays it — the steady
+leg must show ZERO steady recompiles, a fetch count equal to an
+independent monkeypatch spy AND to the engine's declared budget, and
+zero host-numpy re-uploads, with greedy tokens byte-identical to the
+uninstrumented engine. Then one injected violation per probe class (a
+post-steady jit of a fresh program over a prebuilt operand, a stray
+``device_get`` inside one step round, a host-numpy arg tree) must each
+yield exactly one typed flight event and one ``graft-flightlog/v1``
+auto-dump whose trigger names the violation; the device-resident twin
+of the numpy tree must stay silent. ``sentry_*`` receipt fields carry
+the clean-leg summary plus the three caught-flags.
 Prints exactly one JSON line (a ``graft-receipt/v1`` envelope) and
 exits non-zero on any failure.
 """
@@ -103,7 +117,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
              adapters: int = 3, chaos: bool = False,
              flight: bool = False, pipeline: bool = False,
              router: bool = False, paged: bool = False,
-             tp: int = 0) -> dict:
+             tp: int = 0, sentry: bool = False) -> dict:
     import math
     import tempfile
 
@@ -1280,6 +1294,194 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
                 **tpstats,
             }
 
+    # ------------------------------------------------------------------
+    # contract-sentry arm (ISSUE 19): the runtime twin of this harness's
+    # own monkeypatch spies. A sentry-instrumented engine runs the base
+    # stream clean (warmup, mark_steady, then a steady repeat that must
+    # show ZERO steady recompiles, an exactly-balanced fetch budget, and
+    # zero host-numpy re-uploads — with the sentry's counts equal to an
+    # independent monkeypatch spy's). Then one injected violation per
+    # probe class — a post-steady jit of a fresh program, a stray
+    # device_get inside a step round, a host-numpy arg tree — must each
+    # produce exactly one typed flight event and one graft-flightlog/v1
+    # auto-dump naming its trigger.
+    # ------------------------------------------------------------------
+    sentry_fields: dict = {}
+    if sentry:
+        from pytorch_distributed_training_tutorials_tpu.obs import (
+            ContractSentry,
+            FlightRecorder,
+            load_flightlog,
+        )
+
+        fd, sen_dump = tempfile.mkstemp(suffix=".flightlog.jsonl")
+        os.close(fd)
+        fl_sen = FlightRecorder(capacity=256, dump_path=sen_dump)
+        sen = ContractSentry(flight=fl_sen)
+        eng_sen = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=8, max_queue=2,
+            flight=fl_sen, sentry=sen,
+        )
+        count_sen = {"n": 0}
+
+        def counting_sen(x):
+            count_sen["n"] += 1
+            return real_get(x)
+
+        def run_sen_stream(collect):
+            pending = list(prompts)
+            for toks, max_new in pending[:2]:
+                eng_sen.submit(Request(prompt=toks, max_new_tokens=max_new))
+            pending = pending[2:]
+            while not eng_sen.idle or pending:
+                while pending:
+                    toks, max_new = pending[0]
+                    try:
+                        eng_sen.submit(
+                            Request(prompt=toks, max_new_tokens=max_new)
+                        )
+                        pending.pop(0)
+                    except QueueFull:
+                        break
+                for c in eng_sen.step():
+                    collect[c.request_id] = c.tokens
+
+        # the spy goes UNDER the sentry wrapper: every fetch flows
+        # sentry -> spy -> real, so the two counters must agree exactly
+        jax.device_get = counting_sen
+        sen.install()
+        try:
+            # warmup phase: every compiled program this stream needs
+            run_sen_stream({})
+            # prebuild the injection operands while compiles are still
+            # legal — jnp.zeros/arange compile their own fill programs,
+            # which must not pollute the steady-state count
+            stray_scalar = jnp.zeros((), jnp.float32)
+            fresh_arg = jnp.arange(11, dtype=jnp.float32)
+            device_tree = {"w": jnp.ones((4, 4), jnp.float32)}
+            sen.mark_steady()
+
+            # steady clean leg: identical shapes, zero new programs
+            toks_sen: dict = {}
+            base_id = len(prompts)  # phase 1 consumed ids 0..N-1
+            run_sen_stream(toks_sen)
+            sen_exact = all(
+                toks_sen.get(base_id + rid) == completions[rid].tokens
+                for rid in range(len(prompts))
+            )
+            if not sen_exact:
+                problems.append(
+                    f"sentry arm: instrumented engine changed greedy "
+                    f"tokens: {toks_sen}"
+                )
+            if sen.n_steady_recompiles:
+                problems.append(
+                    f"sentry arm: {sen.n_steady_recompiles} steady "
+                    f"recompiles on a shape-identical repeat stream"
+                )
+            if sen.n_budget_violations:
+                problems.append(
+                    f"sentry arm: {sen.n_budget_violations} budget "
+                    "violations on the clean stream"
+                )
+            sen_budget = eng_sen.n_chains + eng_sen.n_prefills
+            if not (sen.n_fetched == count_sen["n"]
+                    == sen.n_budgeted == sen_budget):
+                problems.append(
+                    f"sentry arm: fetch accounting disagrees — sentry "
+                    f"{sen.n_fetched} fetched / {sen.n_budgeted} "
+                    f"budgeted, spy {count_sen['n']}, engine budget "
+                    f"{sen_budget}"
+                )
+            clean_summary = dict(sen.summary())
+
+            # violation leg 1: a post-steady compilation (fresh program
+            # over a PREBUILT operand) — exactly one steady recompile
+            jax.jit(lambda v: v * 3.0 + 1.0)(fresh_arg)
+            recompile_caught = sen.n_steady_recompiles == 1
+            if not recompile_caught:
+                problems.append(
+                    f"sentry arm: injected recompile counted "
+                    f"{sen.n_steady_recompiles} times (want 1; "
+                    f"probe={sen.compile_probe})"
+                )
+
+            # violation leg 2: a stray un-budgeted device_get inside ONE
+            # step round (the leak the fetch-budget rule exists to stop)
+            orig_sweep = eng_sen._sweep
+
+            def leaky_sweep():
+                jax.device_get(stray_scalar)
+                return orig_sweep()
+
+            eng_sen.submit(Request(prompt=prompts[0][0], max_new_tokens=3))
+            eng_sen._sweep = leaky_sweep
+            eng_sen.step()  # exactly one over-budget round
+            eng_sen._sweep = orig_sweep
+            while not eng_sen.idle:
+                eng_sen.step()
+            budget_caught = sen.n_budget_violations == 1
+            if not budget_caught:
+                problems.append(
+                    f"sentry arm: injected stray fetch flagged "
+                    f"{sen.n_budget_violations} rounds (want 1)"
+                )
+
+            # violation leg 3: host-numpy leaves in an arg tree fire the
+            # re-upload probe; the device-resident twin stays silent
+            import numpy as np
+            sen.check_args(
+                {"w": np.ones((4, 4), np.float32)}, label="selftest_numpy"
+            )
+            clean_bytes = sen.check_args(device_tree, label="selftest_numpy")
+            reupload_caught = sen.n_reuploads == 1 and clean_bytes == 0
+            if not reupload_caught:
+                problems.append(
+                    f"sentry arm: reupload probe saw {sen.n_reuploads} "
+                    f"hits / {clean_bytes} B on the device twin "
+                    "(want 1 / 0)"
+                )
+        finally:
+            sen.uninstall()
+            jax.device_get = real_get
+
+        # each injected violation class = one auto-dump naming its
+        # trigger (the chaos-arm contract, extended to the sentry kinds)
+        try:
+            snaps = load_flightlog(sen_dump)
+        except ValueError as e:
+            snaps = []
+            problems.append(f"sentry flight dump failed validation: {e}")
+        by_reason: dict = {}
+        for s in snaps:
+            by_reason.setdefault(s["reason"], []).append(s)
+        for reason, check in (
+            ("compile", lambda t: t.get("steady") is True),
+            ("budget_violation",
+             lambda t: t.get("fetched", 0) > t.get("budgeted", 0)),
+            ("reupload", lambda t: t.get("label") == "selftest_numpy"),
+        ):
+            got = by_reason.get(reason, [])
+            if len(got) != 1:
+                problems.append(
+                    f"sentry arm: {len(got)} '{reason}' dumps (want "
+                    "exactly 1)"
+                )
+            elif not check(got[0].get("trigger") or {}):
+                problems.append(
+                    f"sentry arm: '{reason}' dump trigger does not name "
+                    f"its violation: {got[0].get('trigger')}"
+                )
+        os.unlink(sen_dump)
+        sentry_fields = {
+            **clean_summary,
+            "sentry_token_exact": sen_exact,
+            "sentry_injected_recompile_caught": recompile_caught,
+            "sentry_injected_budget_caught": budget_caught,
+            "sentry_injected_reupload_caught": reupload_caught,
+            "sentry_dump_snapshots": len(snaps),
+        }
+
     receipt = make_receipt(
         "serve_selftest",
         {
@@ -1312,6 +1514,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             **router_fields,
             **fault_fields,
             **tp_fields,
+            **sentry_fields,
             "problems": problems,
             "ok": not problems,
         },
@@ -1388,6 +1591,15 @@ def main(argv: list[str] | None = None) -> int:
         "really sharded, and a clean decode-HLO collective audit "
         "(ISSUE 15)",
     )
+    parser.add_argument(
+        "--sentry", action="store_true",
+        help="also run the contract-sentry arm: a sentry-instrumented "
+        "engine over the base stream (zero steady recompiles, fetch "
+        "accounting equal to an independent monkeypatch spy, zero "
+        "re-uploads), then one injected violation per probe class — "
+        "each must yield exactly one typed flight event and one "
+        "auto-dump naming its trigger (ISSUE 19)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -1410,7 +1622,7 @@ def main(argv: list[str] | None = None) -> int:
                        adapters=args.adapters, chaos=args.chaos,
                        flight=args.flight, pipeline=args.pipeline,
                        router=args.router, paged=args.paged,
-                       tp=args.tp)
+                       tp=args.tp, sentry=args.sentry)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

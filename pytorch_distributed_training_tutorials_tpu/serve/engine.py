@@ -281,6 +281,7 @@ class ServeEngine:
         guard_nonfinite: bool = False,
         chaos=None,
         flight=None,
+        sentry=None,
         pipeline_depth: int = 1,
         prefill_chunk: int = 0,
         paged: bool = False,
@@ -596,6 +597,13 @@ class ServeEngine:
         # device fetch, so the fetch budget and the compiled programs are
         # IDENTICAL either way (tests/test_serve.py pins both).
         self._flight = flight
+        # contract sentry (ISSUE 19): None = off (byte-identical state
+        # tree + compiled programs — the sentry only ever counts on the
+        # host). On, every step() round runs inside a begin/end fetch
+        # accounting window, the budgeted call sites attribute their
+        # fetches through _sentry_fetch, and the chain's dispatch args
+        # are walked for host-numpy re-upload leaves.
+        self._sentry = sentry
         self._inject_logits = chaos is not None and chaos.poisons_logits
         self._cancelled: set[int] = set()
         self.n_deadline_expired = 0
@@ -1735,6 +1743,18 @@ class ServeEngine:
         this round (possibly mid-chain — surplus chain tokens for a
         finished slot are discarded, exactly like ``generate()``
         truncating at ``max_new_tokens``)."""
+        if self._sentry is None:
+            return self._step_impl()
+        # one sentry accounting round per scheduling round: every fetch
+        # inside must arrive through _sentry_fetch or end_round() flags
+        # it — the production twin of the test monkeypatch spies
+        self._sentry.begin_round(f"step:{self.n_chains}")
+        try:
+            return self._step_impl()
+        finally:
+            self._sentry.end_round()
+
+    def _step_impl(self) -> list[Completion]:
         if self._adapters and self._bank.version != self._merged_version:
             # register/evict moved the bank since the last merge: pick
             # the new factors up BEFORE refilling, so freshly admitted
@@ -1775,6 +1795,11 @@ class ServeEngine:
                 ))
             else:
                 args = (self.params, self._state)
+            if self._sentry is not None:
+                # re-upload probe: a host-numpy leaf in the dispatch
+                # tree re-uploads H2D every chain (the
+                # device_materialize trap) — isinstance walk, no fetch
+                self._sentry.check_args(args, label="decode_chain")
             # async dispatch: self._state becomes the chain's OUTPUT
             # futures. Later parks/prefills/chains consume them without
             # a host sync — device program order runs them after this
@@ -1797,6 +1822,21 @@ class ServeEngine:
             done.extend(self._collect_chain())
         return done
 
+    def _sentry_fetch(self, x):
+        """The budgeted host fetch: every budgeted call site
+        (``_collect_chain`` / ``_refill`` / ``_refill_paged`` /
+        ``_advance_one`` / ``_accept_refill``) fetches through here so
+        the contract sentry (ISSUE 19) can attribute it — a bare
+        ``jax.device_get`` anywhere else in the request loop is exactly
+        what the sentry's round accounting flags at runtime (and the
+        graftcheck ``fetch-budget`` rule flags statically; this wrapper
+        is the rule's measuring-instrument exemption, like
+        ``serve/__main__.py``). Sentry-off it IS ``jax.device_get`` —
+        one extra host-side call frame, nothing else."""
+        if self._sentry is not None:
+            self._sentry.budgeted_fetch()
+        return jax.device_get(x)
+
     def _collect_chain(self) -> list[Completion]:
         """Fetch the OLDEST in-flight chain (ONE batched ``device_get``
         — the chain's budgeted fetch) and hand its tokens to the slot
@@ -1804,7 +1844,7 @@ class ServeEngine:
         refilled inside the pipeline window fails the snapshot identity
         check in the distribute and ignores this chain's junk rows."""
         fl = self._inflight.popleft()
-        fetched = jax.device_get(fl.out)  # the chain's ONE host fetch
+        fetched = self._sentry_fetch(fl.out)  # the chain's ONE host fetch
         gen_before = self.generated_tokens
         if self._spec:
             if self._guard:
@@ -2096,7 +2136,7 @@ class ServeEngine:
                 self.prefix.insert(
                     tuple(pkey), new_seg, self._nbytes(new_seg)
                 )
-            first = int(jax.device_get(first))
+            first = int(self._sentry_fetch(first))
         except Exception:
             # request-level isolation: unpin any splice donor, park the
             # slot (prefill may have set its device-side budget before
@@ -2205,7 +2245,7 @@ class ServeEngine:
                 self.n_prefills += 1
             if grow:
                 self._insert_paged_segment(pkey, pages, p_len)
-            first = int(jax.device_get(first))
+            first = int(self._sentry_fetch(first))
         except Exception:
             if segment is not None:
                 self.prefix.release(segment)
@@ -2351,7 +2391,7 @@ class ServeEngine:
                     **akw,
                 )
             self.n_handoffs_in += 1
-            first = int(jax.device_get(first))  # the handoff's ONE fetch
+            first = int(self._sentry_fetch(first))  # the handoff's ONE fetch
         except Exception:
             if pages:
                 self._pool.release_all(pages)
@@ -2643,7 +2683,7 @@ class ServeEngine:
                     self.prefix.insert(
                         tuple(pend.pkey), new_seg, self._nbytes(new_seg)
                     )
-            first = int(jax.device_get(first))
+            first = int(self._sentry_fetch(first))
         except Exception:
             self._abandon_pending(pend)  # also releases pend.pages
             self.n_prefill_errors += 1
@@ -3078,9 +3118,21 @@ class ServeEngine:
             "handoffs_in": self.n_handoffs_in,
         }
 
+    def sentry_stats(self) -> dict[str, int | float]:
+        """Contract-sentry fields for the receipt (ISSUE 19): the
+        ``sentry`` flag is config (regress.py fingerprints it so
+        instrumented and bare rounds never gate each other); compile /
+        fetch / re-upload counters are outcomes. ``{"sentry": 0}`` when
+        off. A fleet sharing ONE sentry reports fleet-global numbers —
+        ``FleetRouter.stats()`` dedupes by sentry identity instead of
+        summing the same counters once per replica."""
+        if self._sentry is None:
+            return {"sentry": 0}
+        return self._sentry.summary()
+
     _STATS_PARTS = (
         "prefix", "spec", "adapters", "fault", "flight", "pipeline",
-        "pages", "tp", "role",
+        "pages", "tp", "role", "sentry",
     )
 
     def stats(self, *parts: str) -> dict[str, int | float]:
@@ -3108,6 +3160,7 @@ class ServeEngine:
             "pages": self.page_stats,
             "tp": self.tp_stats,
             "role": self.role_stats,
+            "sentry": self.sentry_stats,
         }
         out: dict[str, int | float] = {}
         for part in self._STATS_PARTS:

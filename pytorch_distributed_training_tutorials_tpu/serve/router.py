@@ -1099,6 +1099,7 @@ class FleetRouter:
             if fn is not None:
                 per.append(dict(fn(*parts)))
         flight = self.fleet_flight_summary()
+        sentry = self.fleet_sentry_summary()
         merged: Dict[str, Any] = {}
         for d in per:
             for k, v in d.items():
@@ -1109,6 +1110,12 @@ class FleetRouter:
                     "chain_util_", "chain_overlap_",
                 )):
                     continue  # superseded by the histogram merge
+                if sentry is not None and k.startswith("sentry"):
+                    # superseded by the identity-deduped sentry merge:
+                    # a fleet typically shares ONE sentry, and summing
+                    # the same counters once per replica would
+                    # N-multiply every fleet-global count
+                    continue
                 if k not in merged:
                     merged[k] = v
                 elif k not in self._CONFIG_STAT_KEYS and isinstance(
@@ -1118,6 +1125,40 @@ class FleetRouter:
         out.update(merged)
         if flight is not None:
             out.update(flight)
+        if sentry is not None:
+            out.update(sentry)
+        return out
+
+    def fleet_sentry_summary(self) -> Optional[Dict[str, Any]]:
+        """Contract-sentry aggregate across the fleet (ISSUE 19), or
+        None when no replica carries one. Sentries dedupe by IDENTITY:
+        the normal deployment shares one sentry (one process, one
+        ``jax.device_get`` wrapper, one compile listener) across every
+        replica, so its summary is already fleet-global; distinct
+        sentries sum counters, and ``sentry_fetch_budget_ok`` is
+        re-derived from the summed violations (and-ing per-replica
+        booleans via addition would lie)."""
+        seen: Dict[int, Any] = {}
+        for rep in self._replicas:
+            s = getattr(rep.engine, "_sentry", None)
+            if s is not None and id(s) not in seen:
+                seen[id(s)] = s
+        if not seen:
+            return None
+        sentries = list(seen.values())
+        out: Dict[str, Any] = dict(sentries[0].summary())
+        for s in sentries[1:]:
+            for k, v in s.summary().items():
+                if k in out and isinstance(v, (int, float)) and isinstance(
+                    out[k], (int, float)
+                ):
+                    out[k] = out[k] + v
+                else:
+                    out.setdefault(k, v)
+        out["sentry"] = 1
+        out["sentry_fetch_budget_ok"] = int(
+            out.get("sentry_budget_violations", 0) == 0
+        )
         return out
 
     def _tagged_snapshots(self) -> List[Tuple[Any, dict]]:
@@ -1143,18 +1184,50 @@ class FleetRouter:
             return None
         return summarize_merged([snap for _, snap in tagged])
 
+    def _gid_map(self) -> Dict[Tuple[Any, Any], int]:
+        """(replica index, local request id) -> global id, re-derived
+        from the ledger's dispatch records — the same rows
+        :meth:`DispatchLedger.verify` proves exactly-once over. Hedged
+        / re-dispatched gids map from EVERY replica that held them, so
+        a journey shows both sides of a failover."""
+        m: Dict[Tuple[Any, Any], int] = {}
+        for gid, entry in self.ledger.entries.items():
+            for replica, local, _kind, _t in entry.dispatches:
+                m[(replica, local)] = gid
+        return m
+
     def fleet_snapshot(self, reason: str = "fleet") -> Optional[dict]:
         """One merged ``graft-flightlog/v1`` snapshot over the router's
         and every replica's recorder: events tagged ``replica=i`` (the
         router's as ``replica="router"``), interleaved by timestamp —
         pass the same ``t0`` to every recorder or the interleaving is
-        per-recorder-relative. ``scripts/flight_view.py`` renders it."""
+        per-recorder-relative. ``scripts/flight_view.py`` renders it.
+
+        Journey stitching (ISSUE 19): replica-local events and spans
+        that carry a ``rid`` gain the request's GLOBAL ``gid`` (from
+        the ledger's dispatch records), so one request's journey —
+        submit -> prefill replica -> ``handoff_move`` -> decode-replica
+        ``handoff_accept`` -> chains -> complete — is one
+        ``gid=``-filtered slice of the merged timeline
+        (``scripts/flight_view.py --journey GID`` renders it)."""
         from ..obs.flight import merge_snapshots
 
         tagged = self._tagged_snapshots()
         if not tagged:
             return None
-        return merge_snapshots(tagged, reason=reason)
+        snap = merge_snapshots(tagged, reason=reason)
+        gid_map = self._gid_map()
+        for ev in snap["events"]:
+            if "gid" in ev:
+                continue  # router events (handoff_move ...) name gids
+            key = (ev.get("replica"), ev.get("rid"))
+            if ev.get("rid") is not None and key in gid_map:
+                ev["gid"] = gid_map[key]
+        for span in snap["live_spans"] + snap["done_spans"]:
+            key = (span.get("replica"), span.get("rid"))
+            if "gid" not in span and key in gid_map:
+                span["gid"] = gid_map[key]
+        return snap
 
     def dump_fleet(self, path: str, reason: str = "fleet") -> Optional[dict]:
         """Append the merged fleet snapshot to ``path`` (JSONL)."""

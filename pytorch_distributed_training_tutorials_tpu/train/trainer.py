@@ -568,6 +568,7 @@ class Trainer:
         rollback_patience: int = 2,
         rollback_ema: float = 0.9,
         flight=None,
+        sentry=None,
     ):
         self.model = model
         self.loader = train_loader
@@ -701,6 +702,14 @@ class Trainer:
         self._flight = flight
         if flight is not None and self.metrics.flight is None:
             self.metrics.flight = flight
+        # contract sentry (ISSUE 19): None = off (no behavior change at
+        # all). On, each epoch attributes compile events to its phase
+        # label and the TrainState tree is walked once per epoch for
+        # host-numpy leaves — a restored-without-shardings checkpoint
+        # re-uploads the whole model EVERY step (the device_materialize
+        # trap); fresh data batches are deliberately NOT checked, their
+        # H2D is the job.
+        self._sentry = sentry
         # host-side hook points, called OUTSIDE traced code (graftcheck-
         # clean by construction): on_step(step, loss_device_scalar) after
         # each dispatched step/chunk, on_epoch(metrics_dict) after each
@@ -895,6 +904,9 @@ class Trainer:
         return float(self.last_epoch_losses[-1])
 
     def _run_epoch(self, epoch: int) -> dict:
+        if self._sentry is not None:
+            self._sentry.set_phase(f"epoch {epoch}")
+            self._sentry.check_args(self.state, label="train_state")
         if getattr(self.loader, "device_arrays", None) is not None:
             return self._run_epoch_scanned(epoch)
         if (

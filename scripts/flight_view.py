@@ -18,6 +18,7 @@ Usage:
     python scripts/flight_view.py FLIGHT_rXX.jsonl
     python scripts/flight_view.py FLIGHT_rXX.jsonl --events 200
     python scripts/flight_view.py FLIGHT_rXX.jsonl --snapshot -1
+    python scripts/flight_view.py FLEET_rXX.jsonl --journey 7
 """
 
 from __future__ import annotations
@@ -112,6 +113,59 @@ def _pool_annotations(events: list[dict]) -> dict[int, str]:
     return notes
 
 
+def _sentry_annotations(events: list[dict]) -> dict[int, str]:
+    """Contract-sentry lines (ISSUE 19): a post-steady recompile and an
+    over-budget round are the two contract breaks the sentry exists to
+    announce — flag them inline like the health transitions. Warmup
+    compiles render unannotated (they are normal; the label/ms already
+    ride the event fields)."""
+    notes: dict[int, str] = {}
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "compile" and ev.get("steady"):
+            notes[id(ev)] = (
+                f" [recompile: {ev.get('label', '?')} "
+                f"{ev.get('ms', 0)} ms]"
+            )
+        elif kind == "budget_violation":
+            notes[id(ev)] = (
+                f" [fetch over budget: {ev.get('fetched', '?')} > "
+                f"{ev.get('budgeted', '?')}]"
+            )
+        elif kind == "reupload":
+            notes[id(ev)] = (
+                f" [host-numpy re-upload: {ev.get('bytes', '?')} B at "
+                f"{ev.get('label', '?')}]"
+            )
+    return notes
+
+
+def _journey_filter(snap: dict, gid: int) -> dict:
+    """Cut a merged fleet snapshot down to ONE request's cross-replica
+    journey (ISSUE 19): events and spans the router's gid stitching
+    tagged (``FleetRouter.fleet_snapshot``) — submit on the prefill
+    replica, ``handoff_move`` on the router, ``handoff_accept`` +
+    chains on the decode replica, complete. Histograms are fleet-wide
+    aggregates and are dropped; counts re-derive from the kept events."""
+    events = [ev for ev in snap.get("events", []) if ev.get("gid") == gid]
+    live = [s for s in snap.get("live_spans", []) if s.get("gid") == gid]
+    done = [s for s in snap.get("done_spans", []) if s.get("gid") == gid]
+    counts: dict[str, int] = {}
+    for ev in events:
+        counts[ev["kind"]] = counts.get(ev["kind"], 0) + 1
+    return {
+        **snap,
+        "reason": f"journey gid={gid} (of {snap.get('reason')!r})",
+        "events": events,
+        "live_spans": live,
+        "done_spans": done,
+        "histograms": {},
+        "counts": counts,
+        "n_events": len(events),
+        "dropped": 0,
+    }
+
+
 def _fmt_span(span: dict) -> str:
     rid = span.get("rid", "?")
     # fleet dumps tag every span with its replica; local rids collide
@@ -159,6 +213,7 @@ def render(snap: dict, index: int, max_events: int) -> None:
     notes = _chain_annotations(snap["events"])
     notes.update(_health_annotations(snap["events"]))
     notes.update(_pool_annotations(snap["events"]))
+    notes.update(_sentry_annotations(snap["events"]))
     print(f"\nevents (last {min(max_events, len(snap['events']))}):")
     for ev in snap["events"][-max_events:]:
         print(_fmt_event(ev, trigger, notes.get(id(ev), "")))
@@ -199,6 +254,12 @@ def main(argv: list[str] | None = None) -> int:
         help="render only this snapshot index (negative = from the "
         "end); default renders all",
     )
+    ap.add_argument(
+        "--journey", type=int, default=None, metavar="GID",
+        help="render only ONE request's cross-replica journey: keep "
+        "events/spans the fleet merge tagged with this global id "
+        "(FleetRouter.fleet_snapshot's gid stitching, ISSUE 19)",
+    )
     args = ap.parse_args(argv)
     snaps = load_flightlog(args.path)
     if not snaps:
@@ -209,6 +270,14 @@ def main(argv: list[str] | None = None) -> int:
         snaps = [snaps[args.snapshot]]
     else:
         start = 0
+    if args.journey is not None:
+        snaps = [_journey_filter(s, args.journey) for s in snaps]
+        snaps = [s for s in snaps if s["events"] or s["done_spans"]
+                 or s["live_spans"]]
+        if not snaps:
+            print(f"{args.path}: no events tagged gid={args.journey} "
+                  "(was the dump written by FleetRouter.fleet_snapshot?)")
+            return 1
     for i, snap in enumerate(snaps):
         render(snap, start + i, args.events)
     return 0

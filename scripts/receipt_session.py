@@ -23,6 +23,16 @@ Deliberately stdlib-only and jax-free at import: building the command
 list must work on any host (the CPU smoke test does exactly that);
 only actually RUNNING the arms needs the chip.
 
+Every --server arm runs with the runtime contract sentry ON by
+default (ISSUE 19, no flag needed): the receipts below all carry
+sentry_steady_recompiles / sentry_fetch_budget_ok /
+sentry_reupload_bytes, so a contract break on the real chip — a
+steady-state recompile, a stray host sync, a host-numpy re-upload —
+names itself in the receipt (and auto-dumps a flight snapshot)
+instead of silently costing the round. Pass --no-sentry only to
+bisect sentry overhead itself; regress.py fingerprints the `sentry`
+field so the two kinds of round never gate each other.
+
 Usage:
     python scripts/receipt_session.py --round 6 --dry-run   # print plan
     python scripts/receipt_session.py --round 6             # run all

@@ -441,6 +441,72 @@ def test_flight_view_renders_fleet_dump(tmp_path):
     assert "in flight" not in out.stdout
 
 
+def test_flight_view_annotates_sentry_events_and_journey(tmp_path):
+    """ISSUE 19 rendering: post-steady recompiles, over-budget rounds,
+    and host-numpy re-uploads get inline annotations (warmup compiles
+    render unannotated); ``--journey GID`` cuts a merged fleet dump
+    down to one request's gid-tagged cross-replica slice and errors
+    cleanly on a gid nobody tagged."""
+    import json as _json
+    import subprocess
+    from pathlib import Path
+
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import merge_snapshots
+
+    repo = Path(__file__).resolve().parents[1]
+    path = str(tmp_path / "sentry.jsonl")
+    rec = FlightRecorder(capacity=32, dump_path=path)
+    rec.record("compile", label="warmup", ms=120.5, steady=False)
+    rec.record("compile", label="decode", ms=88.0, steady=True)
+    rec.record("budget_violation", fetched=3, budgeted=2, round="step:7")
+    rec.record("reupload", label="params", n_leaves=2, bytes=4096)
+    rec.dump(reason="end_of_stream")
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "flight_view.py"), path],
+        capture_output=True, text=True, timeout=120, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "[recompile: decode 88.0 ms]" in out.stdout
+    assert "[fetch over budget: 3 > 2]" in out.stdout
+    assert "[host-numpy re-upload: 4096 B at params]" in out.stdout
+    # the warmup compile line renders WITHOUT the recompile flag
+    warm_lines = [ln for ln in out.stdout.splitlines()
+                  if "label=warmup" in ln]
+    assert warm_lines and all("recompile" not in ln for ln in warm_lines)
+
+    # --journey: a gid-stitched fleet dump filters to one request
+    t0 = 0.0
+    r0, r1 = FlightRecorder(t0=t0), FlightRecorder(t0=t0)
+    r0.record("prefill", rid=0, p_len=4)
+    r1.record("handoff_accept", rid=0)
+    snap = merge_snapshots(
+        [(0, r0.snapshot()), (1, r1.snapshot())], reason="fleet"
+    )
+    # the router's gid stitching, by hand: replica 0's rid 0 -> gid 7,
+    # replica 1's colliding rid 0 -> a DIFFERENT request, gid 8
+    for ev in snap["events"]:
+        ev["gid"] = 7 if ev["replica"] == 0 else 8
+    jpath = str(tmp_path / "fleet.jsonl")
+    with open(jpath, "w") as f:
+        f.write(_json.dumps(snap) + "\n")
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "flight_view.py"),
+         jpath, "--journey", "7"],
+        capture_output=True, text=True, timeout=120, cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "journey gid=7" in out.stdout
+    assert "prefill" in out.stdout
+    assert "handoff_accept" not in out.stdout  # gid 8's event filtered
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "flight_view.py"),
+         jpath, "--journey", "99"],
+        capture_output=True, text=True, timeout=120, cwd=str(repo),
+    )
+    assert out.returncode == 1
+    assert "no events tagged gid=99" in out.stdout
+
+
 def test_flight_view_annotates_pool_events(tmp_path):
     """Paged-KV pool events render with their inline annotations: a
     pool_shed shows the page demand that bounced, a page_cow shows the

@@ -838,6 +838,67 @@ def test_disagg_exactly_once_and_stats():
     assert sum(e.n_handoffs_in for e in engines[1:]) == 6
 
 
+def test_fleet_snapshot_stitches_cross_replica_journeys():
+    """ISSUE 19 journey stitching: one request's events/spans across a
+    1p2d fleet — submit on the prefill replica, the router's
+    handoff_move, accept + chains on the decode replica — all carry the
+    ledger-derived ``gid`` tag in the merged snapshot, so ONE request's
+    cross-replica journey is a gid= filter over the merged timeline
+    (scripts/flight_view.py --journey). Events already naming a gid
+    (the router's own) are left untouched; local rids that collide
+    across replicas resolve to DIFFERENT gids via the replica tag."""
+    t0 = 0.0
+    engines, router = _disagg_fleet(1, 2, flight=FlightRecorder(t0=t0))
+    pre, d0, d1 = engines
+    recs = [FlightRecorder(t0=t0) for _ in engines]
+    for eng, rec in zip(engines, recs):
+        eng.flight = rec
+    reqs = [_req(s, max_new=4) for s in range(4)]
+    gids = [router.submit(dataclasses.replace(r)) for r in reqs]
+    # stamp the prefill side the way the real engine does: a span +
+    # rid-carrying events per local request id
+    for lrid in list(pre.submitted):
+        recs[0].request_submitted(lrid, p_len=4, max_new=4)
+    done = {c.request_id: c for c in router.run_until_idle()}
+    assert set(done) == set(gids)
+    # decode side: both replicas assign local rids from 0 — the
+    # COLLISION the (replica, rid) key exists to disambiguate
+    for di, dec in ((1, d0), (2, d1)):
+        for lrid in dec.submitted:
+            recs[di].record("handoff_accept", rid=lrid)
+            recs[di].request_submitted(lrid, p_len=4, max_new=4)
+            recs[di].request_completed(lrid, "length", tokens=4,
+                                       latency_s=0.1, ttft_s=0.05)
+    assert d0.submitted and d1.submitted  # journeys really split
+    snap = router.fleet_snapshot(reason="journeys")
+    validate_flightlog(snap)
+    gid_map = router._gid_map()
+    # every rid-carrying event got its gid; replica-colliding local
+    # rids resolved to different gids
+    for ev in snap["events"]:
+        if ev.get("rid") is not None:
+            assert ev["gid"] == gid_map[(ev["replica"], ev["rid"])]
+    lrid0 = d0.submitted[0]
+    if lrid0 in d1.submitted:
+        assert gid_map[(1, lrid0)] != gid_map[(2, lrid0)]
+    # the router's own handoff_move events carry their gid natively
+    moves = [ev for ev in snap["events"] if ev["kind"] == "handoff_move"]
+    assert len(moves) == 4
+    assert all(ev["gid"] in gids for ev in moves)
+    # one request's journey = the gid filter: it must span BOTH the
+    # prefill replica (submit) and a decode replica (accept/complete)
+    g0 = gid_map[(1, d0.submitted[0])]
+    journey = [ev for ev in snap["events"] if ev.get("gid") == g0]
+    assert {ev["replica"] for ev in journey} >= {0, 1, "router"}
+    kinds = {ev["kind"] for ev in journey}
+    assert {"submit", "handoff_move", "handoff_accept",
+            "complete"} <= kinds
+    # spans got stitched too: the decode-side done span carries the gid
+    done_spans = [s for s in snap["done_spans"] if s.get("gid") == g0]
+    assert len(done_spans) == 1 and done_spans[0]["replica"] == 1
+    assert router.ledger.verify() == []
+
+
 def test_disagg_handoffs_go_to_least_loaded_decode():
     engines, router = _disagg_fleet(1, 2)
     _, d0, d1 = engines

@@ -1,0 +1,339 @@
+"""obs/sentry.py: the runtime contract sentry (ISSUE 19).
+
+The sentry is the production twin of this suite's own monkeypatch
+spies: a compile probe (zero steady-state recompiles), a fetch probe
+(per-round accounting against the declared budget = chains + prefills
++ splices), and a re-upload probe (host-numpy leaves in dispatched arg
+trees — the ``device_materialize`` trap). The load-bearing pins:
+
+- an injected POST-steady compilation (a fresh jit program over a
+  PREBUILT operand — jnp array creation itself compiles fill programs,
+  which must never pollute the count) produces exactly ONE steady
+  recompile, one typed ``compile`` flight event with ``steady=True``,
+  and one ``graft-flightlog/v1`` auto-dump naming its phase;
+- on a composed engine (prefix cache ON, splices in the budget) the
+  sentry's fetch count equals an independent monkeypatch spy's AND the
+  engine's declared budget, with zero violations — and a deliberately
+  leaked in-round sync flags exactly one violation;
+- a host-numpy arg tree fires the re-upload probe with honest bytes;
+  its ``device_materialize``-pinned twin is silent;
+- sentry-off engines keep byte-identical state trees (no new leaves)
+  and identical greedy tokens; install/uninstall restores
+  ``jax.device_get`` exactly, marker-guarded so a spy layered on top
+  is never clobbered.
+
+Import purity: obs/sentry.py is in HOST_ONLY_MODULES — the no-jax
+subprocess pin lives with its siblings in tests/test_prefix.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from pytorch_distributed_training_tutorials_tpu.obs.flight import FlightRecorder
+from pytorch_distributed_training_tutorials_tpu.obs.sentry import ContractSentry
+from pytorch_distributed_training_tutorials_tpu.serve import Request, ServeEngine
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_layers=2, n_heads=2, max_seq_len=48
+    )
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return cfg, model, params
+
+
+def _prompts(cfg, n=4, seed=3):
+    rng = np.random.Generator(np.random.PCG64(seed))
+    shared = rng.integers(0, cfg.vocab_size, (10,)).tolist()
+    out = []
+    for i in range(n):
+        tail = rng.integers(0, cfg.vocab_size, (2 + i,)).tolist()
+        out.append(shared + tail)
+    return out
+
+
+def _run(engine, prompts, max_new=5):
+    toks = {}
+    for p in prompts:
+        engine.submit(Request(prompt=p, max_new_tokens=max_new))
+    while not engine.idle:
+        for c in engine.step():
+            toks[c.request_id] = c.tokens
+    return toks
+
+
+# ------------------------------------------------------------ compile probe
+
+def test_post_steady_recompile_is_exactly_one_violation(tmp_path):
+    """Warmup compiles are attributed and legal; after mark_steady a
+    fresh jit program is exactly one violation — one ``compile`` event
+    with steady=True and one auto-dump naming its phase. The operand is
+    PREBUILT pre-steady (array creation compiles its own fill program)."""
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import load_flightlog
+
+    dump = str(tmp_path / "sentry.jsonl")
+    fl = FlightRecorder(capacity=64, dump_path=dump)
+    sen = ContractSentry(flight=fl)
+    with sen:
+        arr = jnp.arange(13, dtype=jnp.float32)
+        add_one = jax.jit(lambda v: v + 1.0)
+        add_one(arr)                              # warmup compile
+        warm = sen.n_compiles
+        assert warm >= 1                          # probe is live
+        assert sen.n_steady_recompiles == 0
+        sen.set_phase("decode")
+        sen.mark_steady()
+        add_one(arr)                              # cache hit: no compile
+        assert sen.n_steady_recompiles == 0
+        jax.jit(lambda v: v * 2.0 - 1.0)(arr)    # fresh program: violation
+        assert sen.n_steady_recompiles == 1
+        assert sen.n_compiles == warm + 1
+    snaps = load_flightlog(dump)
+    compile_dumps = [s for s in snaps if s["reason"] == "compile"]
+    assert len(compile_dumps) == 1
+    trig = compile_dumps[0]["trigger"]
+    assert trig["kind"] == "compile" and trig["steady"] is True
+    assert trig["label"] == "steady"  # mark_steady moved the phase
+    # warmup compiles recorded as plain events, never dumped
+    warm_evs = [ev for ev in compile_dumps[0]["events"]
+                if ev["kind"] == "compile" and not ev["steady"]]
+    assert len(warm_evs) >= 1
+
+
+def test_compile_records_are_bounded():
+    sen = ContractSentry(max_compile_records=2)
+    for _ in range(5):
+        sen._on_compile(1.0)
+    assert len(sen.compile_records) == 2
+    assert sen.n_compiles == 5  # counters never truncate
+
+
+# ------------------------------------------------------------- fetch probe
+
+def test_fetch_accounting_matches_spy_on_composed_engine(tiny_lm):
+    """The acceptance criterion: on a composed engine (prefix cache ON
+    — splices join the budget) the sentry's fetch count equals an
+    independent monkeypatch spy layered UNDERNEATH it, equals its own
+    budgeted count, equals the engine's declared budget = chains +
+    prefills + splices. Zero violations on the clean stream."""
+    cfg, model, params = tiny_lm
+    sen = ContractSentry()
+    eng = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=4,
+        prefix_cache_bytes=1 << 20, sentry=sen,
+    )
+    spy = {"n": 0}
+    real_get = jax.device_get
+
+    def counting(x):
+        spy["n"] += 1
+        return real_get(x)
+
+    jax.device_get = counting
+    sen.install()
+    try:
+        toks = _run(eng, _prompts(cfg))
+    finally:
+        sen.uninstall()
+        jax.device_get = real_get
+    assert len(toks) == 4
+    assert eng.n_splices > 0  # the composition actually fired
+    budget = eng.n_chains + eng.n_prefills + eng.n_splices
+    assert sen.n_fetched == spy["n"] == sen.n_budgeted == budget
+    assert sen.n_budget_violations == 0
+    assert sen.n_rounds > 0
+    assert sen.summary()["sentry_fetch_budget_ok"] == 1
+
+
+def test_stray_in_round_fetch_is_exactly_one_violation(tiny_lm):
+    """A deliberately leaked sync inside ONE step round (injected via
+    the engine's own sweep seam) flags exactly one budget_violation,
+    with the event naming fetched > budgeted; rounds after the leak is
+    removed stay clean."""
+    cfg, model, params = tiny_lm
+    fl = FlightRecorder(capacity=64)
+    sen = ContractSentry(flight=fl)
+    eng = ServeEngine(
+        model, params, n_slots=2, tokens_per_launch=4, sentry=sen,
+    )
+    with sen:
+        stray = jnp.zeros(())          # prebuilt: its fill compile is
+        _run(eng, _prompts(cfg, n=2))  # warmup, not a steady recompile
+        orig_sweep = eng._sweep
+
+        def leaky_sweep():
+            jax.device_get(stray)
+            return orig_sweep()
+
+        eng.submit(Request(prompt=_prompts(cfg, n=1)[0],
+                           max_new_tokens=3))
+        eng._sweep = leaky_sweep
+        eng.step()                     # ONE over-budget round
+        eng._sweep = orig_sweep
+        while not eng.idle:
+            eng.step()
+    assert sen.n_budget_violations == 1
+    evs = [e for e in fl.events if e["kind"] == "budget_violation"]
+    assert len(evs) == 1
+    assert evs[0]["fetched"] > evs[0]["budgeted"]
+    assert evs[0]["round"].startswith("step:")
+
+
+def test_fetches_outside_rounds_never_violate():
+    """Warmup fetches, reference decodes, receipt assembly — anything
+    outside a begin/end_round window counts toward totals but can never
+    flag: the budget is a per-round contract."""
+    sen = ContractSentry()
+    with sen:
+        x = jnp.ones((3,))
+        jax.device_get(x)              # outside any round
+        sen.begin_round("clean")
+        sen.budgeted_fetch()
+        jax.device_get(x)
+        sen.end_round()
+    assert sen.n_fetched == 2
+    assert sen.n_budgeted == 1
+    assert sen.n_rounds == 1
+    assert sen.n_budget_violations == 0
+
+
+# ---------------------------------------------------------- re-upload probe
+
+def test_host_numpy_tree_fires_materialized_twin_silent(tiny_lm):
+    """The device_materialize trap, both sides: a host-numpy leaf in an
+    arg tree fires with honest bytes; the device-pinned twin
+    (utils.tree.device_materialize — the documented fix) is silent.
+    Repeat offenders accumulate counters but announce only once per
+    site label."""
+    from pytorch_distributed_training_tutorials_tpu.utils.tree import device_materialize
+
+    fl = FlightRecorder(capacity=64)
+    sen = ContractSentry(flight=fl)
+    host_tree = {"w": np.ones((8, 4), np.float32),
+                 "b": np.zeros((4,), np.float32)}
+    pinned = device_materialize(host_tree)
+    assert sen.check_args(pinned, label="pinned") == 0
+    want = host_tree["w"].nbytes + host_tree["b"].nbytes
+    assert sen.check_args(host_tree, label="restore") == want
+    assert sen.check_args(host_tree, label="restore") == want
+    assert sen.n_reuploads == 2            # every occurrence counted
+    assert sen.reupload_bytes == 2 * want
+    evs = [e for e in fl.events if e["kind"] == "reupload"]
+    assert len(evs) == 1                   # announced once per site
+    assert evs[0]["label"] == "restore"
+    assert evs[0]["bytes"] == want and evs[0]["n_leaves"] == 2
+
+
+# ---------------------------------------------- engine off-path + lifecycle
+
+def test_sentry_off_engine_is_byte_identical(tiny_lm):
+    """sentry=None keeps the slot-state tree byte-identical (no new
+    leaves) and greedy tokens unchanged vs the instrumented engine —
+    the standard off-path contract."""
+    cfg, model, params = tiny_lm
+    eng_off = ServeEngine(model, params, n_slots=2, tokens_per_launch=4)
+    sen = ContractSentry()
+    eng_on = ServeEngine(model, params, n_slots=2, tokens_per_launch=4,
+                         sentry=sen)
+    paths_off = [p for p, _ in
+                 jax.tree_util.tree_flatten_with_path(eng_off._state)[0]]
+    paths_on = [p for p, _ in
+                jax.tree_util.tree_flatten_with_path(eng_on._state)[0]]
+    assert paths_off == paths_on
+    prompts = _prompts(cfg, n=3)
+    toks_off = _run(eng_off, prompts)
+    with sen:
+        toks_on = _run(eng_on, prompts)
+    assert toks_on == toks_off
+    # an installed-but-roundless sentry never flags; the engine opened
+    # rounds for it and budgeted every fetch
+    assert sen.n_budget_violations == 0
+    assert sen.n_rounds > 0
+
+
+def test_uninstall_restores_device_get_marker_guarded():
+    """Uninstall restores the exact prior jax.device_get — and refuses
+    to clobber a spy someone layered ON TOP of the sentry wrapper (the
+    marker guard): the spy's owner unwinds it, not us."""
+    real = jax.device_get
+    sen = ContractSentry()
+    sen.install()
+    wrapped = jax.device_get
+    assert wrapped is not real
+    assert getattr(wrapped, "_contract_sentry", None) is sen
+    sen.uninstall()
+    assert jax.device_get is real
+    # now with a spy on top: uninstall must leave the spy in place
+    sen2 = ContractSentry()
+    sen2.install()
+
+    def spy(x):
+        return real(x)
+
+    jax.device_get = spy
+    sen2.uninstall()
+    assert jax.device_get is spy
+    jax.device_get = real
+
+
+def test_summary_keys_and_stats_part(tiny_lm):
+    """summary() is the receipt surface: the sentry config flag + the
+    outcome counters, and engine.stats() exposes it as the `sentry`
+    part ({'sentry': 0} when off)."""
+    cfg, model, params = tiny_lm
+    sen = ContractSentry()
+    s = sen.summary()
+    assert s["sentry"] == 1
+    for k in ("sentry_compiles", "sentry_steady_recompiles",
+              "sentry_rounds", "sentry_fetched", "sentry_budgeted",
+              "sentry_budget_violations", "sentry_fetch_budget_ok",
+              "sentry_reuploads", "sentry_reupload_bytes"):
+        assert k in s
+    eng_off = ServeEngine(model, params, n_slots=1, tokens_per_launch=4)
+    assert eng_off.stats("sentry") == {"sentry": 0}
+    eng_on = ServeEngine(model, params, n_slots=1, tokens_per_launch=4,
+                         sentry=sen)
+    assert eng_on.stats("sentry")["sentry"] == 1
+
+
+# ------------------------------------------------------------- trainer seam
+
+def test_trainer_threads_sentry_phases_and_state_check():
+    """Trainer(sentry=...) attributes compiles to per-epoch phases and
+    walks the TrainState once per epoch through the re-upload probe —
+    a device-resident state is silent."""
+    import optax
+
+    from pytorch_distributed_training_tutorials_tpu.data import ShardedLoader
+    from pytorch_distributed_training_tutorials_tpu.models import LinearRegressor
+    from pytorch_distributed_training_tutorials_tpu.parallel.mesh import create_mesh
+    from pytorch_distributed_training_tutorials_tpu.train import Trainer
+
+    rng = np.random.Generator(np.random.PCG64(0))
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    y = (x @ rng.standard_normal((4, 1)).astype(np.float32))
+    from pytorch_distributed_training_tutorials_tpu.data.datasets import ArrayDataset
+
+    mesh = create_mesh({"data": 8})
+    loader = ShardedLoader(ArrayDataset((x, y)), 8, mesh)
+    sen = ContractSentry()
+    trainer = Trainer(
+        LinearRegressor(in_dim=4), loader, optax.sgd(1e-2), loss="mse",
+        quiet=True, sentry=sen,
+    )
+    with sen:
+        trainer.train(2)
+    assert sen.n_checked == 2              # one TrainState walk per epoch
+    assert sen.n_reuploads == 0            # sharded state is on device
+    assert sen.phase == "epoch 1"          # phases moved with the epochs

@@ -1671,6 +1671,45 @@ def test_serve_selftest_flight_subprocess(tmp_path):
     assert load_receipt(json_path)["ok"] is True
 
 
+@pytest.mark.slow
+def test_serve_selftest_sentry_subprocess(tmp_path):
+    """``--selftest --sentry`` — the contract-sentry arm (ISSUE 19): a
+    sentry-instrumented engine over the base stream shows zero steady
+    recompiles, fetch accounting equal to an independent monkeypatch
+    spy AND the declared budget, and zero re-uploads, token-exact to
+    the bare engine; then one injected violation per probe class each
+    yields exactly one typed flight event + one auto-dump naming its
+    trigger."""
+    from pytorch_distributed_training_tutorials_tpu.obs import load_receipt, validate_receipt
+
+    json_path = str(tmp_path / "selftest_sentry.json")
+    out = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_training_tutorials_tpu.serve", "--selftest",
+         "--sentry", "--json", json_path],
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+        env=os.environ.copy(),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    receipt = json.loads(out.stdout.strip().splitlines()[-1])
+    assert receipt["ok"] is True, receipt.get("problems")
+    assert validate_receipt(receipt, kind="serve_selftest") == []
+    assert receipt["sentry"] == 1
+    assert receipt["sentry_token_exact"] is True
+    # the clean steady leg: every contract held (the summary snapshot
+    # is taken BEFORE the injected violations)
+    assert receipt["sentry_steady_recompiles"] == 0
+    assert receipt["sentry_fetch_budget_ok"] == 1
+    assert receipt["sentry_reuploads"] == 0
+    assert receipt["sentry_fetched"] == receipt["sentry_budgeted"] > 0
+    # each injected violation class was caught exactly once, with one
+    # graft-flightlog/v1 auto-dump per class
+    assert receipt["sentry_injected_recompile_caught"] is True
+    assert receipt["sentry_injected_budget_caught"] is True
+    assert receipt["sentry_injected_reupload_caught"] is True
+    assert receipt["sentry_dump_snapshots"] == 3
+    assert load_receipt(json_path)["ok"] is True
+
+
 # ------------------------------------------ request-loop pipelining (ISSUE 11)
 
 def test_pipeline_validation():

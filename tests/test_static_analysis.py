@@ -1007,6 +1007,30 @@ def test_fetch_budget_exempts_the_selftest_harness():
     assert not hits(check(src, path="serve/__main__.py"), "fetch-budget")
 
 
+def test_fetch_budget_sentry_wrapper_is_a_measuring_instrument():
+    # ISSUE 19 fixture pair: `_sentry_fetch` is HOW every budgeted site
+    # fetches (count + delegate — the production twin of the selftest
+    # spies), so its body is exempt; the SAME sync in any other serve/
+    # function still fires — the exemption never grows the budget.
+    clean = """
+        import jax
+
+        def _sentry_fetch(self, x):
+            if self._sentry is not None:
+                self._sentry.budgeted_fetch()
+            return jax.device_get(x)
+    """
+    assert not hits(check(clean, path="serve/engine.py"), "fetch-budget")
+    stray = """
+        import jax
+
+        def _sentry_stats(self):
+            return jax.device_get(self.counters)
+    """
+    found = hits(check(stray, path="serve/engine.py"), "fetch-budget")
+    assert [f.line for f in found] == [5]
+
+
 def test_fetch_budget_item_with_args_is_not_a_sync():
     # dict.item-style calls with arguments are not the jax .item() sync
     src = """
