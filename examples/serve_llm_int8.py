@@ -372,7 +372,7 @@ def main():
     )
     ap.add_argument(
         "--qps", type=float, default=0.0,
-        help="for --server --replicas: offered load in requests/s — an "
+        help="for --server: offered load in requests/s — an "
         "OPEN-loop Poisson arrival process (seeded exponential "
         "inter-arrivals; QueueFull arrivals are shed and counted, the "
         "honest overload behavior). 0 (default) submits the whole "
@@ -398,6 +398,19 @@ def main():
         "ledger_ok (exactly-once across the transfer)",
     )
     ap.add_argument(
+        "--slo", action="store_true",
+        help="for --server: two SLO priority classes (ISSUE 20) — every "
+        "4th request submits class 0 (interactive), the rest class 1 "
+        "(batch). When a class-0 arrival finds all slots busy, the "
+        "engine preempts the lowest-class active slot at the chain "
+        "boundary (its KV segment swaps to host and later resumes "
+        "token-exact); the receipt gains slo_stats() (n_preemptions, "
+        "swap counters) and the preempt_wait histogram. Pair with "
+        "--qps so arrivals are spaced — an up-front burst is drained "
+        "in strict class order and never needs to preempt. "
+        "Single-engine arm only",
+    )
+    ap.add_argument(
         "--unrolled", action="store_true",
         help="serve with L unrolled block copies instead of the default "
         "stacked nn.scan body (the unrolled program is O(L) larger; on "
@@ -406,6 +419,14 @@ def main():
         "models.transformer.stack_quantized_lm_params)",
     )
     args = ap.parse_args()
+
+    if args.slo and (args.replicas > 1 or args.disaggregate):
+        # preemption swaps are a single-engine contract (the engine
+        # forbids role= + priority_classes; a fleet would also need
+        # class-aware routing the router spells class_deadline_s /
+        # per-class hedge_after_s) — keep the receipt arm honest
+        ap.error("--slo is the single-engine arm (ISSUE 20); drop "
+                 "--replicas/--disaggregate")
 
     if os.environ.get("JAX_PLATFORMS"):
         import jax
@@ -693,6 +714,9 @@ def _reset_serving_counters(engine) -> None:
     engine.nonfinite_quarantined = engine.n_prefill_errors = 0
     engine.n_chunks = 0
     engine.n_handoffs_out = engine.n_handoffs_in = 0
+    if hasattr(engine, "n_swaps_out"):
+        # SLO engines only (priority-off engines don't grow the attrs)
+        engine.n_swaps_out = engine.n_swaps_in = 0
     if engine.prefix is not None:
         engine.prefix.hits = engine.prefix.misses = 0
 
@@ -1128,6 +1152,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         sentry=sentry,
         pipeline_depth=args.pipeline_depth,
         prefill_chunk=args.prefill_chunk,
+        priority_classes=2 if args.slo else 0,
         strategy=_serving_strategy(lm),
         **_paged_kwargs(args, window),
     )
@@ -1156,6 +1181,10 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
             deadline_s=deadline_s,
             # cycle every bank row (0 = base) through the shared slots
             adapter=(i % args.adapters) if bank is not None else 0,
+            # SLO arm (ISSUE 20): every 4th request is interactive
+            # (class 0), the rest batch (class 1) — the mix that makes
+            # a class-0 arrival find the slots full of class-1 work
+            priority=(0 if i % 4 == 0 else 1) if args.slo else 0,
         )
 
     # compile warmup: one request per prompt bucket + the decode chain,
@@ -1182,8 +1211,31 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         sentry.mark_steady()
 
     t0 = time.perf_counter()
-    for i in range(args.requests):
-        engine.submit(mk_request(len(lengths) + i))
+    if args.qps > 0:
+        # open-loop Poisson arrivals (same seeded process as the fleet
+        # arm): requests land at their arrival instants regardless of
+        # progress. The SLO arm needs this spacing — an up-front burst
+        # is drained in strict class order by the PriorityScheduler and
+        # never needs to preempt an occupied slot
+        arng = np.random.Generator(np.random.PCG64(17))
+        arrivals, t_arr = [], 0.0
+        for _ in range(args.requests):
+            t_arr += float(arng.exponential(1.0 / args.qps))
+            arrivals.append(t_arr)
+        next_i = 0
+        while next_i < len(arrivals):
+            due = t0 + arrivals[next_i]
+            if time.perf_counter() >= due:
+                engine.submit(mk_request(len(lengths) + next_i))
+                next_i += 1
+                continue
+            if engine.idle:
+                time.sleep(min(0.001, max(0.0, due - time.perf_counter())))
+            else:
+                engine.step()
+    else:
+        for i in range(args.requests):
+            engine.submit(mk_request(len(lengths) + i))
     engine.run_until_idle()
     # the drain's last chain ended in a real fetch (engine.step's
     # device_get), but close the region explicitly so wall-clock honesty
@@ -1206,6 +1258,7 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         new_tokens=new,
         max_seq_len=window,
         temperature=args.temperature,
+        qps=args.qps,
         server_wall_s=round(wall_s, 2),
         server_tok_per_s=round(toks / wall_s, 1),
         server_generated_tokens=toks,
@@ -1258,6 +1311,13 @@ def serve_request_stream(args, cfg, lm, params, receipt: dict) -> None:
         prefix_note += (
             f", pipeline depth {ps['pipeline_depth']} "
             f"(chunk {ps['prefill_chunk']}, {ps['n_chunks']} chunks)"
+        )
+    if args.slo:
+        st = engine.slo_stats()
+        prefix_note += (
+            f", slo: {st['priority_classes']} classes, "
+            f"{st['n_preemptions']} preemptions "
+            f"({st['n_swaps_out']} out / {st['n_swaps_in']} in)"
         )
     if sentry is not None:
         sentry.uninstall()

@@ -38,6 +38,7 @@ HOST_ONLY_MODULES: tuple[str, ...] = (
     f"{_PKG}.serve.prefix",
     f"{_PKG}.serve.router",
     f"{_PKG}.serve.scheduler",
+    f"{_PKG}.serve.slo",
     f"{_PKG}.utils.chaos",
 )
 

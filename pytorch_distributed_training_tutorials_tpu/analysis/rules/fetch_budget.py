@@ -1,11 +1,13 @@
 """fetch-budget: serve/ host syncs only at the budgeted call sites.
 
 THE serving invariant (CLAUDE.md): the fetch budget is exactly chains +
-prefills + splices — one batched ``jax.device_get`` per decode chain in
-``_collect_chain``, one scalar fetch per prefill/splice in ``_refill`` /
-``_refill_paged`` / ``_advance_one``, and one per accepted handoff in
-``_accept_refill`` (the disaggregated decode role's intake — its
-prefill-role counterpart fetches nothing). Every other host sync in the
+prefills + splices + counted swaps — one batched ``jax.device_get`` per
+decode chain in ``_collect_chain``, one scalar fetch per prefill/splice
+in ``_refill`` / ``_refill_paged`` / ``_advance_one``, one per accepted
+handoff in ``_accept_refill`` (the disaggregated decode role's intake —
+its prefill-role counterpart fetches nothing), and one batched segment
+fetch per SLO preemption in ``_swap_out`` (ISSUE 20 — swap-in costs
+zero: it re-splices device-side). Every other host sync in the
 request loop is a stall the ~75-130 ms per-launch roundtrip multiplies:
 a stray ``.item()`` in a sweep or a ``device_get`` in a stats method
 silently turns a launch-amortized engine back into per-token traffic.
@@ -41,6 +43,10 @@ BUDGETED_FUNCTIONS = frozenset({
     "_accept_refill",   # disaggregated handoff's scalar fetch (ISSUE 18:
                         # the prefill role fetches NOTHING — the decode
                         # role's accept splice carries the one fetch)
+    "_swap_out",        # SLO preemption's batched segment fetch (ISSUE
+                        # 20: parking a victim's KV to host IS a fetch —
+                        # counted as n_swaps_out; swap-IN re-splices on
+                        # device and fetches nothing)
 })
 
 # Measuring instruments, not budget lines (ISSUE 19): the contract
@@ -72,7 +78,7 @@ class FetchBudget(Rule):
     description = (
         "host syncs in serve/ (device_get / .item() / np.asarray / "
         "block_until_ready) only inside the budgeted call sites — the "
-        "budget is exactly chains + prefills + splices"
+        "budget is exactly chains + prefills + splices + counted swaps"
     )
 
     def check(self, ctx) -> Iterator[Finding]:
@@ -97,8 +103,8 @@ class FetchBudget(Rule):
                         f"{hit} outside the budgeted call sites "
                         f"({', '.join(sorted(BUDGETED_FUNCTIONS))}); the "
                         "serve/ fetch budget is exactly chains + prefills "
-                        "+ splices — batch the value into an existing "
-                        "budgeted fetch or keep it on device",
+                        "+ splices + counted swaps — batch the value into "
+                        "an existing budgeted fetch or keep it on device",
                     )
             yield from self._walk(ctx, child, budgeted)
 

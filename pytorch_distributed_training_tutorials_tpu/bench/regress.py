@@ -152,6 +152,14 @@ CONFIG_FIELDS = (
     # (sentry_compiles, sentry_steady_recompiles, sentry_fetched,
     # sentry_reupload_bytes, ...) stay out — outcomes, not configuration
     "sentry",
+    # SLO tiers (ISSUE 20): the class count and the preemption flag
+    # change what a tok/s or per-class TTFT number MEANS (a preempting
+    # engine trades low-class latency for high-class tails), so SLO
+    # rounds never gate — or get gated by — FIFO rounds; the swap
+    # counters (n_preemptions, n_swaps_out/in, swapped_now) and the
+    # preempted-wait histogram stay out — outcomes of the traffic mix,
+    # not configuration
+    "priority_classes", "preemption",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)")

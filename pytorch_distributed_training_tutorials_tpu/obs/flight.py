@@ -26,7 +26,8 @@ Three pieces, all pure host bookkeeping:
 Contract with the serve/train stack (pinned by tests/test_serve.py and
 tests/test_flight.py): the recorder is host-only — stamping an event
 costs a clock read and a deque append, NEVER a device fetch, so the
-engine's fetch budget stays exactly chains + prefills + splices; a
+engine's fetch budget stays exactly chains + prefills + splices (+
+counted swaps under SLO preemption, ISSUE 20); a
 recorder-off engine keeps byte-identical state trees and compiled
 programs (the same off-path pattern the spec/adapter/robustness layers
 use). Timestamping here uses ``time.perf_counter()`` in a jax-free
@@ -81,6 +82,8 @@ EVENT_KINDS = frozenset({
     "compile",           # contract sentry: one XLA compilation (ISSUE 19)
     "budget_violation",  # contract sentry: round fetches exceeded budget
     "reupload",          # contract sentry: host-numpy leaves in a dispatch
+    "preempt",           # SLO: active slot swapped out to host (ISSUE 20)
+    "resume",            # SLO: preempted request re-spliced into a slot
 })
 
 # Faults trigger an auto-dump when a dump_path is configured. The two
@@ -157,6 +160,10 @@ class FlightRecorder:
             # host roundtrip is hidden). 0.0 lands in the underflow
             # bucket, so the count still reflects every chain.
             "chain_overlap": LogHistogram(min_value=1e-3, max_value=4.0),
+            # swap-out -> swap-in wall time of preempted requests
+            # (ISSUE 20) — the price a lower SLO class pays so a
+            # higher class can hold its TTFT
+            "preempt_wait": LogHistogram(),
         }
         # dispatch stamps of chains whose fetch has not landed yet,
         # keyed by the engine's chain sequence number — pipelined
@@ -325,6 +332,25 @@ class FlightRecorder:
     def sweep(self, completed: int) -> None:
         self.record("sweep", completed=completed)
 
+    def preempted(self, rid: Any, slot: int = 0, position: int = 0,
+                  tokens: int = 0) -> None:
+        """An SLO preemption swapped ``rid`` out of ``slot`` to host
+        (ISSUE 20): ``position`` is the sequence position parked,
+        ``tokens`` the generated tokens kept. Host-only like every
+        stamp — the swap's device fetch is counted by the ENGINE
+        (n_swaps_out), not here."""
+        self.record("preempt", rid=rid, slot=slot, position=position,
+                    tokens=tokens)
+
+    def resumed(self, rid: Any, slot: int = 0,
+                wait_s: float = 0.0) -> None:
+        """A preempted request re-spliced into ``slot``; ``wait_s`` is
+        the swap-out -> swap-in wall time, fed to the preempted-wait
+        histogram."""
+        self.record("resume", rid=rid, slot=slot,
+                    wait_s=round(float(wait_s), 6))
+        self.hist["preempt_wait"].record(wait_s)
+
     def fault(self, fault_kind: str, **fields: Any) -> None:
         """A fault_stats-visible anomaly (nonfinite / deadline /
         prefill_error / adapter_evicted ...). Auto-dumps when a
@@ -395,6 +421,10 @@ class FlightRecorder:
         out.update(self.hist["chain_util"].summary(prefix="chain_util_"))
         out.update(
             self.hist["chain_overlap"].summary(prefix="chain_overlap_")
+        )
+        out.update(
+            self.hist["preempt_wait"].summary(prefix="preempt_wait_",
+                                              unit="s")
         )
         return {
             k: (round(v, 6) if isinstance(v, float) else v)
@@ -492,6 +522,7 @@ def summarize_merged(snaps: List[dict]) -> dict:
         "queue_wait": ("queue_wait_", "s"),
         "chain_util": ("chain_util_", None),
         "chain_overlap": ("chain_overlap_", None),
+        "preempt_wait": ("preempt_wait_", "s"),
     }
     for name, (prefix, unit) in prefixes.items():
         h = hists.get(name)

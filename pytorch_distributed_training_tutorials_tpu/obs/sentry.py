@@ -2,7 +2,7 @@
 
 Every engine contract the reference-reproduction depends on — "nothing
 recompiles per request" (CLAUDE.md serving invariants), "fetch budget =
-chains + prefills + splices (+ handoffs_in)", "no host-numpy leaf
+chains + prefills + splices (+ handoffs_in + counted swaps)", "no host-numpy leaf
 re-uploads per call" (the DECODE_r04 trap: 2.7 -> 508 tok/s) — is pinned
 by monkeypatch spies and ``_cache_size()`` counts in CPU-mesh tests, but
 on the real chip nothing watches them at runtime. :class:`ContractSentry`
@@ -29,8 +29,9 @@ measuring it):
   through :meth:`budgeted_fetch` (via ``ServeEngine._sentry_fetch``), so
   inside a :meth:`begin_round`/:meth:`end_round` window — one ``step()``
   scheduling round — ``fetched > budgeted`` means a stray sync leaked
-  outside the budget (chains + prefills + splices + handoffs_in;
-  prefill-role budget 0). The violation records a ``budget_violation``
+  outside the budget (chains + prefills + splices + handoffs_in +
+  swaps_out under SLO preemption, ISSUE 20; prefill-role budget 0). The
+  violation records a ``budget_violation``
   event, which auto-dumps through the recorder's existing fault path.
 - **Re-upload probe**: :meth:`check_args` walks a dispatched arg tree
   for host-``numpy`` leaves — the ``device_materialize`` trap, where a

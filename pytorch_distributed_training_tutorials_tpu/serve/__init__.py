@@ -28,7 +28,12 @@ Public surface:
   :func:`.router.affinity_hash` — the jax-free multi-replica fleet
   front door (ISSUE 12): replica health states with a circuit breaker,
   exactly-once re-dispatch off dead/draining replicas, hedged
-  stragglers, prefix-affinity routing, merged fleet receipts.
+  stragglers, prefix-affinity routing, merged fleet receipts;
+- :class:`.slo.PriorityScheduler` — the jax-free multi-class queue
+  behind ``ServeEngine(priority_classes=N)`` (ISSUE 20): pop by
+  (SLO class, arrival), plus chain-boundary preemption by KV swap —
+  a lower-class active slot parks to host for a higher-class waiter
+  and later resumes token-exact.
 
 ``python -m pytorch_distributed_training_tutorials_tpu.serve --selftest`` runs the end-to-end smoke
 (token-exactness vs ``generate()`` included) and prints one receipt line
@@ -59,6 +64,8 @@ _LAZY_EXPORTS = {
     "QueueClosed": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "QueueFull": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
     "Request": "pytorch_distributed_training_tutorials_tpu.serve.scheduler",
+    "PriorityScheduler": "pytorch_distributed_training_tutorials_tpu.serve.slo",
+    "SwapRecord": "pytorch_distributed_training_tutorials_tpu.serve.slo",
     "bucket_len": "pytorch_distributed_training_tutorials_tpu.serve.slots",
     "extract_segment": "pytorch_distributed_training_tutorials_tpu.serve.slots",
     "init_slot_state": "pytorch_distributed_training_tutorials_tpu.serve.slots",

@@ -101,6 +101,22 @@ yield exactly one typed flight event and one ``graft-flightlog/v1``
 auto-dump whose trigger names the violation; the device-resident twin
 of the numpy tree must stay silent. ``sentry_*`` receipt fields carry
 the clean-leg summary plus the three caught-flags.
+A twelfth (``--slo``) arm runs the SLO-tier gauntlet (ISSUE 20): a
+``priority_classes=2`` engine decodes a low-class request on its only
+slot when a class-0 request arrives — the engine must PREEMPT (KV
+swap-out to host, counted ``n_swaps_out``), serve the interactive
+request, then swap the victim back in; BOTH streams must be
+token-exact to one-shot ``generate()`` (preemption is invisible in the
+tokens), the fetch budget is chains + prefills + splices + counted
+swaps (the monkeypatch spy counts the swap-out's one batched segment
+fetch), and a :class:`..obs.sentry.ContractSentry` riding the same
+stream must close every round balanced — the runtime proof that swap
+fetches flow through the budgeted ``_sentry_fetch`` seam. A chaos leg
+(``preempt_at_chain``) force-preempts a slot with NO real pressure: the
+victim resumes token-exact and the co-scheduled slot's tokens are
+byte-identical to a preemption-free run. A host-only leg pins
+``PriorityScheduler(n_classes=1)`` pop-order-identical to
+``FifoScheduler`` over the same submission sequence.
 Prints exactly one JSON line (a ``graft-receipt/v1`` envelope) and
 exits non-zero on any failure.
 """
@@ -117,7 +133,8 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
              adapters: int = 3, chaos: bool = False,
              flight: bool = False, pipeline: bool = False,
              router: bool = False, paged: bool = False,
-             tp: int = 0, sentry: bool = False) -> dict:
+             tp: int = 0, sentry: bool = False,
+             slo: bool = False) -> dict:
     import math
     import tempfile
 
@@ -1482,6 +1499,176 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             "sentry_dump_snapshots": len(snaps),
         }
 
+    # ------------------------------------------------------------------
+    # slo arm (--slo, ISSUE 20): priority scheduling + preemption by KV
+    # swap. A 1-slot priority engine decoding a low-class request must
+    # preempt for an arriving class-0 request (swap the victim's cache
+    # segment to host — the counted swap fetch), serve the interactive
+    # request, swap the victim back in, and finish BOTH token-exact to
+    # generate(). Budget = chains + prefills + splices + swaps, pinned
+    # by the monkeypatch spy AND a ContractSentry riding the stream.
+    # Chaos leg: preempt_at_chain force-preempts with no pressure; the
+    # victim resumes token-exact and the co-scheduled slot is
+    # byte-identical to a clean run. Host leg: single-class
+    # PriorityScheduler pop order == FifoScheduler.
+    # ------------------------------------------------------------------
+    slo_fields: dict = {}
+    if slo:
+        from pytorch_distributed_training_tutorials_tpu.obs import ContractSentry
+        from pytorch_distributed_training_tutorials_tpu.serve.scheduler import FifoScheduler
+        from pytorch_distributed_training_tutorials_tpu.serve.slo import PriorityScheduler
+        from pytorch_distributed_training_tutorials_tpu.utils.chaos import ChaosConfig
+
+        lo_toks, lo_new = prompts[4]   # (2, 17): 3 chains of decode
+        hi_toks, hi_new = prompts[3]   # (12, 6): the interactive burst
+
+        def one_shot(toks, max_new):
+            return jax.device_get(
+                generate(
+                    model, params, jnp.asarray([toks], jnp.int32), max_new
+                )
+            )[0, len(toks):].tolist()
+
+        lo_ref = one_shot(lo_toks, lo_new)
+        hi_ref = one_shot(hi_toks, hi_new)
+
+        sen_slo = ContractSentry()
+        eng_slo = ServeEngine(
+            model, params, n_slots=1, tokens_per_launch=8,
+            priority_classes=2, sentry=sen_slo,
+        )
+        count_slo = {"n": 0}
+
+        def counting_slo(x):
+            count_slo["n"] += 1
+            return real_get(x)
+
+        # spy goes UNDER the sentry wrapper (sentry -> spy -> real), the
+        # same layering the --sentry arm uses, so both counters see
+        # every fetch including the swap-out's
+        jax.device_get = counting_slo
+        sen_slo.install()
+        try:
+            slo_done = {}
+            lo_req = Request(prompt=list(lo_toks), max_new_tokens=lo_new,
+                             priority=1)
+            eng_slo.submit(lo_req)
+            for c in eng_slo.step():   # prefill + first chain (9 of 17)
+                slo_done[c.request_id] = c
+            hi_req = Request(prompt=list(hi_toks), max_new_tokens=hi_new,
+                             priority=0)
+            eng_slo.submit(hi_req)
+            while not eng_slo.idle:
+                for c in eng_slo.step():
+                    slo_done[c.request_id] = c
+            slo_fetches = count_slo["n"]
+        finally:
+            sen_slo.uninstall()
+            jax.device_get = real_get
+        if eng_slo.n_swaps_out < 1 or eng_slo.n_swaps_in < 1:
+            problems.append(
+                f"slo arm: no preemption fired (swaps out "
+                f"{eng_slo.n_swaps_out} / in {eng_slo.n_swaps_in})"
+            )
+        slo_exact = (
+            slo_done[lo_req.request_id].tokens == lo_ref
+            and slo_done[hi_req.request_id].tokens == hi_ref
+        )
+        if not slo_exact:
+            problems.append(
+                f"slo arm: preemption changed greedy tokens — lo "
+                f"{slo_done[lo_req.request_id].tokens} vs {lo_ref}, hi "
+                f"{slo_done[hi_req.request_id].tokens} vs {hi_ref}"
+            )
+        slo_budget = (
+            eng_slo.n_chains + eng_slo.n_prefills + eng_slo.n_splices
+            + eng_slo.n_swaps_out
+        )
+        if slo_fetches > slo_budget:
+            problems.append(
+                f"slo arm: {slo_fetches} host fetches > {slo_budget} "
+                f"({eng_slo.n_chains} chains + {eng_slo.n_prefills} "
+                f"prefills + {eng_slo.n_splices} splices + "
+                f"{eng_slo.n_swaps_out} swaps)"
+            )
+        # the sentry's round accounting is the same claim at runtime:
+        # every swap fetch flowed through the budgeted _sentry_fetch
+        # seam, so no round closed with fetched > budgeted
+        if sen_slo.n_budget_violations:
+            problems.append(
+                f"slo arm: {sen_slo.n_budget_violations} sentry budget "
+                f"violations — a swap fetch escaped the budgeted seam"
+            )
+        if sen_slo.n_fetched != sen_slo.n_budgeted:
+            problems.append(
+                f"slo arm: sentry fetched {sen_slo.n_fetched} != "
+                f"budgeted {sen_slo.n_budgeted}"
+            )
+
+        # chaos leg: forced preempt with NO pressure — the co-scheduled
+        # slot must be byte-identical to a clean 2-slot run
+        clean2 = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+        a_req = Request(prompt=list(lo_toks), max_new_tokens=lo_new)
+        b_req = Request(prompt=list(hi_toks), max_new_tokens=hi_new)
+        clean2.submit(a_req)
+        clean2.submit(b_req)
+        clean_out = {c.request_id: c.tokens for c in clean2.run_until_idle()}
+        chaos2 = ServeEngine(
+            model, params, n_slots=2, tokens_per_launch=8,
+            priority_classes=2,
+            chaos=ChaosConfig(preempt_slot=0, preempt_at_chain=1),
+        )
+        a2 = Request(prompt=list(lo_toks), max_new_tokens=lo_new, priority=1)
+        b2 = Request(prompt=list(hi_toks), max_new_tokens=hi_new, priority=1)
+        chaos2.submit(a2)
+        chaos2.submit(b2)
+        chaos_out = {c.request_id: c.tokens
+                     for c in chaos2.run_until_idle()}
+        if chaos2.n_swaps_out != 1:
+            problems.append(
+                f"slo arm: chaos preempt fired {chaos2.n_swaps_out} "
+                "times (want exactly 1)"
+            )
+        chaos_exact = (
+            chaos_out[a2.request_id] == clean_out[a_req.request_id]
+            and chaos_out[b2.request_id] == clean_out[b_req.request_id]
+        )
+        if not chaos_exact:
+            problems.append(
+                f"slo arm: forced preempt changed tokens — "
+                f"{chaos_out} vs clean {clean_out}"
+            )
+
+        # host leg: single-class PriorityScheduler pop order ==
+        # FifoScheduler over the same submissions (jax-free)
+        fifo = FifoScheduler(64, max_queue=16)
+        single = PriorityScheduler(64, max_queue=16, n_classes=1)
+        for p_len in (3, 7, 5, 12, 2):
+            fifo.submit(Request(prompt=[1] * p_len, max_new_tokens=4))
+            single.submit(Request(prompt=[1] * p_len, max_new_tokens=4))
+        fifo_order = []
+        single_order = []
+        while True:
+            f, s = fifo.pop(), single.pop()
+            if f is None and s is None:
+                break
+            fifo_order.append(None if f is None else f.request_id)
+            single_order.append(None if s is None else s.request_id)
+        if fifo_order != single_order:
+            problems.append(
+                f"slo arm: single-class PriorityScheduler order "
+                f"{single_order} != FIFO {fifo_order}"
+            )
+
+        slo_fields = {
+            **eng_slo.stats("slo"),
+            "slo_token_exact": slo_exact,
+            "slo_chaos_token_exact": chaos_exact,
+            "slo_host_fetches": slo_fetches,
+            "slo_fetch_budget": slo_budget,
+            "slo_single_class_fifo_identical": fifo_order == single_order,
+        }
+
     receipt = make_receipt(
         "serve_selftest",
         {
@@ -1515,6 +1702,7 @@ def selftest(json_path: str | None = None, spec_k: int = 2,
             **fault_fields,
             **tp_fields,
             **sentry_fields,
+            **slo_fields,
             "problems": problems,
             "ok": not problems,
         },
@@ -1600,6 +1788,15 @@ def main(argv: list[str] | None = None) -> int:
         "each must yield exactly one typed flight event and one "
         "auto-dump naming its trigger (ISSUE 19)",
     )
+    parser.add_argument(
+        "--slo", action="store_true",
+        help="also run the SLO-tier arm: a priority_classes=2 engine "
+        "preempting a low-class slot (KV swap to host) for a class-0 "
+        "arrival, both streams token-exact to generate(), budget = "
+        "chains + prefills + splices + counted swaps pinned by the spy "
+        "AND the contract sentry, plus the chaos forced-preempt and "
+        "single-class-equals-FIFO legs (ISSUE 20)",
+    )
     args = parser.parse_args(argv)
     if not args.selftest:
         parser.print_help()
@@ -1622,7 +1819,7 @@ def main(argv: list[str] | None = None) -> int:
                        adapters=args.adapters, chaos=args.chaos,
                        flight=args.flight, pipeline=args.pipeline,
                        router=args.router, paged=args.paged,
-                       tp=args.tp, sentry=args.sentry)
+                       tp=args.tp, sentry=args.sentry, slo=args.slo)
     print(json.dumps(receipt))
     return 0 if receipt["ok"] else 1
 

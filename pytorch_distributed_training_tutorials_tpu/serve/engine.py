@@ -166,6 +166,11 @@ from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
     Handoff,
     Request,
 )
+from pytorch_distributed_training_tutorials_tpu.serve.slo import (
+    PriorityScheduler,
+    SwapRecord,
+    choose_victim,
+)
 from pytorch_distributed_training_tutorials_tpu.serve.slots import (
     _POOL_TO_FLAT,
     _leaf_name,
@@ -291,6 +296,7 @@ class ServeEngine:
         kv_bits: int | None = None,
         paged_kernel: bool = False,
         role: str | None = None,
+        priority_classes: int = 0,
     ):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
@@ -384,6 +390,24 @@ class ServeEngine:
             raise ValueError(
                 "default_deadline_s must be > 0 (None = no deadline)"
             )
+        # SLO tiers (ISSUE 20): 0 = off — the engine keeps the FIFO
+        # scheduler and constructs NO swap programs, so off engines are
+        # byte-identical (state tree + compiled-program census) to the
+        # pre-SLO build. N >= 1 admits priority classes [0, N), pops by
+        # (class, arrival), and under pressure preempts the lowest-tier
+        # active slot at the chain boundary via the KV swap path below.
+        if priority_classes < 0:
+            raise ValueError(
+                "priority_classes must be >= 0 (0 = single-class FIFO)"
+            )
+        if priority_classes and role is not None:
+            raise ValueError(
+                "priority_classes requires role=None: preemption swaps "
+                "in through the monolithic refill path; role-split "
+                "fleets shape traffic at the router"
+            )
+        self._slo = priority_classes > 0
+        self._n_classes = int(priority_classes)
         # sharded serving (ISSUE 15): a TensorParallel strategy shards the
         # slot/KV state on the model (head) axis to match the attention
         # sharding the params already carry — TP serving is the existing
@@ -503,7 +527,14 @@ class ServeEngine:
             raise ValueError("speculative_k + 1 must fit the window")
         if self._spec and spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
-        self.scheduler = FifoScheduler(self.window, max_queue=max_queue)
+        self.scheduler = (
+            PriorityScheduler(
+                self.window, max_queue=max_queue,
+                n_classes=self._n_classes,
+            )
+            if self._slo
+            else FifoScheduler(self.window, max_queue=max_queue)
+        )
         self._slots: list[_Active | None] = [None] * n_slots
         self._state = init_slot_state(
             self._dec_model, params, n_slots,
@@ -555,12 +586,14 @@ class ServeEngine:
         self._inflight: collections.deque[_InFlight] = collections.deque()
         self._pending: dict[int, _PendingPrefill] = {}
         self.n_chunks = 0
-        if self._retain or self._chunk or role == "decode":
+        if self._retain or self._chunk or role == "decode" or self._slo:
             # shape/dtype proto of the batch-1 decode cache — seed_cache
             # builds the splice start state from it, chunked prefill its
-            # zeroed side cache, and a decode-role engine both validates
+            # zeroed side cache, a decode-role engine both validates
             # incoming handoff segments against it and seeds their
-            # accept splice from it (eval_shape: no FLOPs, no buffers)
+            # accept splice from it, and the SLO swap-in re-splices a
+            # preempted request's parked segment through it
+            # (eval_shape: no FLOPs, no buffers)
             self._proto1 = jax.eval_shape(
                 lambda p, t: self.model.apply(
                     {"params": p}, t, decode=True, mutable=["cache"]
@@ -618,6 +651,16 @@ class ServeEngine:
         self._handoff_in: dict[int, Handoff] = {}
         self.n_handoffs_out = 0
         self.n_handoffs_in = 0
+        # SLO preemption (ISSUE 20): parked swap records by request id
+        # (host numpy — the swap-out fetch already paid for the bytes),
+        # the one-shot latch for the chaos force-preempt injector, and
+        # the receipt counters. Attrs exist only when the feature is on
+        # (the attrs-don't-exist off-path contract).
+        if self._slo:
+            self._swapped: dict[int, SwapRecord] = {}
+            self._chaos_preempt_fired = False
+            self.n_swaps_out = 0
+            self.n_swaps_in = 0
         # donating the state tree lets XLA update the multi-hundred-MB
         # cache in place; CPU jit warns on donation (unsupported), so
         # only donate where it is real
@@ -739,6 +782,25 @@ class ServeEngine:
             self._accept_jit = jax.jit(
                 self._accept_paged_fn if self._paged
                 else self._accept_fn,
+                donate_argnums=donate,
+            )
+        # SLO swap programs (ISSUE 20): constructed only under
+        # priority_classes, so FIFO engines keep a byte-identical
+        # compiled-program census. Swap-out reads live state (the slot
+        # may keep decoding if the preemption re-check bails) — never
+        # donated; its seg_len is STATIC from the same pow2 bucket
+        # family as prefill, so swaps never mint per-length compiles.
+        # Swap-in is the accept splice pointed at a host-parked segment:
+        # slot state donated like every other refill-time surgery.
+        if self._slo:
+            self._swap_out_jit = jax.jit(
+                self._swap_out_paged_fn if self._paged
+                else self._swap_out_fn,
+                static_argnames=("seg_len",),
+            )
+            self._swap_in_jit = jax.jit(
+                self._swap_in_paged_fn if self._paged
+                else self._swap_in_fn,
                 donate_argnums=donate,
             )
 
@@ -1355,6 +1417,126 @@ class ServeEngine:
             )
         return new_state, first
 
+    # -- SLO preemption twins (ISSUE 20) -----------------------------------
+
+    def _swap_leaves(self, state, slot, segment):
+        """Shared tail of the swap-out programs: bundle the segment with
+        the slot's sampling leaves (next decode input, PRNG stream
+        mid-sequence, and the n-gram history when speculation is on) so
+        the host parks EVERYTHING the swap-in needs behind ONE batched
+        fetch — the swap's single budgeted ``device_get``."""
+        out = {
+            "segment": segment,
+            "last_tok": state["last_tok"][slot],
+            "key": state["keys"][slot],
+        }
+        if self._spec:
+            out["hist"] = state["hist"][slot]
+            out["hist_len"] = state["hist_len"][slot]
+        return out
+
+    def _swap_out_fn(self, state, slot, *, seg_len):
+        """Swap-out (whole-slot): cut slot ``slot``'s cache down to a
+        batch-1 tree (``dynamic_slice_in_dim`` along the slot axis —
+        slot is traced, no per-slot compiles) and extract positions
+        ``[0, seg_len)`` — the Handoff extraction pointed at host: the
+        segment covers every position the slot has WRITTEN (``seg_len``
+        is the static pow2 bucket of the current position, same compile
+        family as prefill), so re-splicing it via ``seed_cache`` +
+        ``write_slot`` rebuilds the slot bitwise — nothing is
+        recomputed, so quantized caches round-trip exactly too. Reads
+        live state (never donated): the host re-checks the victim after
+        draining the pipeline and may keep it decoding."""
+
+        def cut(path, leaf):
+            if _leaf_name(path) == "cache_index":
+                return jax.lax.dynamic_slice_in_dim(
+                    leaf, slot, 1, axis=leaf.ndim - 1
+                )
+            ax = 1 if self._scan_layers else 0
+            return jax.lax.dynamic_slice_in_dim(leaf, slot, 1, axis=ax)
+
+        cache1 = jax.tree_util.tree_map_with_path(cut, state["cache"])
+        return self._swap_leaves(state, slot, extract_segment(
+            cache1, seg_len, self._scan_layers
+        ))
+
+    def _swap_out_paged_fn(self, state, row, slot, position, *, seg_len):
+        """Paged swap-out: gather the slot's pool pages (``row``: its
+        live page table, sentinel-padded) into the unpaged batch-1
+        layout — the :meth:`_chunk_seed_paged_fn` gather reused as an
+        extractor — then cut the position bucket exactly like the
+        whole-slot twin. The pages themselves return to the pool on the
+        host side the moment the fetch lands; this program only reads
+        them."""
+        cache1 = self._chunk_seed_paged_fn(state["cache"], row, position)
+        return self._swap_leaves(state, slot, extract_segment(
+            cache1, seg_len, self._scan_layers
+        ))
+
+    def _swap_in_fn(self, params, state, segment, last_tok, key,
+                    position, slot, remaining, hist=None, hist_len=None,
+                    aid=0):
+        """Swap-in (whole-slot): the :meth:`_accept_fn` splice pointed
+        at a host-parked segment — ``seed_cache`` + ``write_slot``
+        rebuild the preempted slot at ``position`` bitwise (nothing
+        recomputed: the disaggregation argument verbatim), and the
+        sampling leaves restore VERBATIM instead of being re-seeded:
+        ``remaining`` is the request's live budget (not ``max_new - 1``)
+        and ``key`` the PRNG stream mid-sequence, so the resumed
+        request's tokens are exactly the undisturbed run's. ``params``
+        is unused but keeps ``state`` at donate index 1."""
+        del params  # swap-in recomputes nothing
+        cache1 = self._pin(seed_cache(self._proto1, segment, position))
+        cache = self._pin(write_slot(
+            state["cache"], cache1, slot, position, self._scan_layers
+        ))
+        return self._swap_in_rest(
+            state, cache, last_tok, key, slot, remaining, hist,
+            hist_len, aid,
+        )
+
+    def _swap_in_paged_fn(self, params, state, segment, row, last_tok,
+                          key, position, slot, remaining, hist=None,
+                          hist_len=None, aid=0):
+        """Paged swap-in: scatter the rebuilt batch-1 cache into the
+        slot's FRESH pages (``write_slot_paged`` full-row — sanitizing,
+        like every paged refill); page ids were re-allocated host-side,
+        so a resumed request may land on different physical pages than
+        it held — invisible in the tokens, the page table is DATA."""
+        del params
+        cache1 = self._pin(seed_cache(self._proto1, segment, position))
+        cache = self._pin(write_slot_paged(
+            state["cache"], cache1, row, slot, position,
+            self._page_size, self._scan_layers,
+        ))
+        return self._swap_in_rest(
+            state, cache, last_tok, key, slot, remaining, hist,
+            hist_len, aid,
+        )
+
+    def _swap_in_rest(self, state, cache, last_tok, key, slot,
+                      remaining, hist, hist_len, aid):
+        """Shared bookkeeping tail of the swap-in programs."""
+        new_state = {
+            "cache": cache,
+            "last_tok": state["last_tok"].at[slot].set(last_tok),
+            "keys": state["keys"].at[slot].set(key),
+            "remaining": state["remaining"].at[slot].set(remaining),
+        }
+        if self._spec:
+            new_state["hist"] = state["hist"].at[slot].set(
+                hist.astype(state["hist"].dtype)
+            )
+            new_state["hist_len"] = state["hist_len"].at[slot].set(
+                hist_len
+            )
+        if self._adapters:
+            new_state["adapter_ids"] = state["adapter_ids"].at[slot].set(
+                jnp.asarray(aid, jnp.int32)
+            )
+        return new_state
+
     def _chain_fn(self, params, state):
         """``tokens_per_launch`` decode steps as one ``lax.scan`` — one
         launch, one (S, T) token block out. Every slot steps every time
@@ -1766,6 +1948,11 @@ class ServeEngine:
         if self._flight is not None and done:
             self._flight.sweep(len(done))
         done.extend(self._advance_pending())
+        if self._slo:
+            # preemption decision at the chain boundary, BEFORE refill:
+            # a freed (swapped-out) slot is refillable this very round,
+            # so the waiting high-class request starts immediately
+            done.extend(self._maybe_preempt())
         for s in range(self.n_slots):
             if self._slots[s] is not None or s in self._pending:
                 continue
@@ -1825,7 +2012,8 @@ class ServeEngine:
     def _sentry_fetch(self, x):
         """The budgeted host fetch: every budgeted call site
         (``_collect_chain`` / ``_refill`` / ``_refill_paged`` /
-        ``_advance_one`` / ``_accept_refill``) fetches through here so
+        ``_advance_one`` / ``_accept_refill`` / ``_swap_out``) fetches
+        through here so
         the contract sentry (ISSUE 19) can attribute it — a bare
         ``jax.device_get`` anywhere else in the request loop is exactly
         what the sentry's round accounting flags at runtime (and the
@@ -1954,6 +2142,196 @@ class ServeEngine:
                 done.append(self._complete(act, reason))
         return done
 
+    def _maybe_preempt(self) -> list[Completion]:
+        """SLO preemption decision (ISSUE 20), at the chain boundary
+        only. Pressure = a strictly higher class is waiting AND no slot
+        can take it (every slot occupied/pending, or — paged — the pool
+        cannot back the best waiter even with a free slot). Under
+        pressure the lowest-tier active slot (:func:`..serve.slo.
+        choose_victim` — strictly-lower tier only, most recent admit
+        loses first) is swapped out. Before the swap the in-flight
+        pipeline is DRAINED: the device is ahead of the host's token
+        view at depth > 1, and the swap must capture exactly the state
+        the host has accounted for — those collections are the chains'
+        own already-budgeted fetches, so the budget stays chains +
+        prefills + splices + swaps. After draining, the victim is
+        re-checked (it may have completed inside a drained chain). The
+        chaos ``preempt_at_chain`` injector forces a named slot through
+        the same path, once, for pressure-free testing."""
+        done: list[Completion] = []
+        victim: int | None = None
+        c = self._chaos
+        if (
+            c is not None
+            and getattr(c, "preempts", False)
+            and not self._chaos_preempt_fired
+            and self.n_chains >= c.preempt_at_chain
+        ):
+            self._chaos_preempt_fired = True
+            victim = int(c.preempt_slot)
+            if (
+                victim >= self.n_slots
+                or self._slots[victim] is None
+            ):
+                return done
+        else:
+            wait = self.scheduler.peek_priority()
+            if wait is None:
+                return done
+            free = any(
+                self._slots[s] is None and s not in self._pending
+                for s in range(self.n_slots)
+            )
+            pressure = not free
+            if not pressure and self._paged:
+                head = self.scheduler.peek_request()
+                if head is not None and int(getattr(
+                    head, "priority", 0
+                )) == wait:
+                    need = self._pool.pages_needed(
+                        len(head.prompt) + head.max_new_tokens
+                    )
+                    pressure = self._pool.available < need
+            if not pressure:
+                return done
+            victim = choose_victim(
+                [
+                    (s, int(getattr(a.request, "priority", 0)),
+                     a.request.request_id)
+                    for s, a in enumerate(self._slots)
+                    if a is not None
+                ],
+                wait,
+            )
+            if victim is None:
+                return done
+        # drain the pipeline so device state == the host's token view
+        # (each collection is that chain's own budgeted fetch)
+        while self._inflight:
+            done.extend(self._collect_chain())
+        if self._slots[victim] is None:
+            # the victim finished inside a drained chain — pressure is
+            # already relieved by its free slot
+            return done
+        self._swap_out(victim)
+        return done
+
+    def _swap_out(self, slot: int) -> None:
+        """Park slot ``slot``'s request to host: ONE budgeted batched
+        ``device_get`` (segment + sampling leaves — the swap fetch the
+        budget line counts), then the slot parks exactly like a
+        completion would (pages return to the pool on paged engines)
+        and the request re-enters the queue at its ARRIVAL position
+        (``PriorityScheduler.requeue``) holding a
+        :class:`..serve.slo.SwapRecord` for the swap-in."""
+        act = self._slots[slot]
+        req = act.request
+        position = len(req.prompt) + len(act.tokens) - 1
+        seg_len = bucket_len(position, self.window)
+        if self._paged:
+            row = jnp.asarray(
+                act.pages
+                + [self._pool_pages] * (
+                    self._pages_per_slot - len(act.pages)
+                ),
+                jnp.int32,
+            )
+            out = self._swap_out_jit(
+                self._state, row, slot, position, seg_len=seg_len
+            )
+        else:
+            out = self._swap_out_jit(self._state, slot, seg_len=seg_len)
+        host = self._sentry_fetch(out)  # the swap's ONE budgeted fetch
+        self.n_swaps_out += 1
+        self._slots[slot] = None
+        if self._paged:
+            self._park_paged(slot, act)
+        else:
+            self._state["remaining"] = self._park(
+                self._state["remaining"], slot
+            )
+        if act.segment is not None:
+            # the slot no longer decodes from its splice donor; swap-in
+            # re-splices from the parked segment, not the donor
+            self.prefix.release(act.segment)
+            act.segment = None
+        self._swapped[req.request_id] = SwapRecord(
+            active=act,
+            segment=host["segment"],
+            last_tok=host["last_tok"],
+            key=host["key"],
+            position=position,
+            seg_len=seg_len,
+            hist=host.get("hist"),
+            hist_len=host.get("hist_len"),
+            preempt_t=time.perf_counter(),
+        )
+        self.scheduler.requeue(req)
+        if self._flight is not None:
+            self._flight.preempted(
+                req.request_id, slot=slot, position=position,
+                tokens=len(act.tokens),
+            )
+
+    def _swap_in(self, slot: int, req: Request,
+                 rec: SwapRecord) -> list[Completion]:
+        """Resume a preempted request into slot ``slot``: re-upload the
+        parked leaves and replay the accept splice with the request's
+        LIVE progress (``remaining``/``key``/history verbatim) — zero
+        host fetches, so the budget line grows only by swap-OUTS. A
+        failure isolates to this request (``"error"``, pre-preemption
+        tokens kept), exactly like a raising prefill."""
+        act = rec.active
+        pages: list[int] = []
+        try:
+            segment = jax.tree_util.tree_map(jnp.asarray, rec.segment)
+            kw = {}
+            if self._spec:
+                kw["hist"] = jnp.asarray(rec.hist)
+                kw["hist_len"] = jnp.asarray(rec.hist_len)
+            if self._adapters:
+                kw["aid"] = int(getattr(req, "adapter", 0))
+            if self._paged:
+                pages = self._pool.alloc(self._pool.pages_needed(
+                    len(req.prompt) + req.max_new_tokens
+                ))
+                row = jnp.asarray(
+                    pages
+                    + [self._pool_pages] * (
+                        self._pages_per_slot - len(pages)
+                    ),
+                    jnp.int32,
+                )
+                self._state = self._swap_in_jit(
+                    self.params, self._state, segment, row,
+                    jnp.asarray(rec.last_tok), jnp.asarray(rec.key),
+                    rec.position, slot, act.remaining, **kw,
+                )
+                act.pages = pages
+            else:
+                self._state = self._swap_in_jit(
+                    self.params, self._state, segment,
+                    jnp.asarray(rec.last_tok), jnp.asarray(rec.key),
+                    rec.position, slot, act.remaining, **kw,
+                )
+        except Exception:
+            if pages:
+                self._pool.release_all(pages)
+            self.n_prefill_errors += 1
+            if self._flight is not None:
+                self._flight.fault(
+                    "swap_in_error", rid=req.request_id, slot=slot
+                )
+            return [self._complete(act, "error")]
+        self.n_swaps_in += 1
+        self._slots[slot] = act
+        if self._flight is not None:
+            self._flight.resumed(
+                req.request_id, slot=slot,
+                wait_s=time.perf_counter() - rec.preempt_t,
+            )
+        return []
+
     def run_until_idle(self, max_steps: int = 10_000) -> list[Completion]:
         """Drain queue + slots; returns completions in finish order."""
         out: list[Completion] = []
@@ -2036,17 +2414,28 @@ class ServeEngine:
         request: the slot parks, the request completes ``"error"``, and
         the engine keeps serving everyone else — one poisoned prompt
         (or one injected :class:`..utils.chaos.ChaosError`) must never
-        take the process down."""
+        take the process down.
+
+        A request carrying a :class:`..serve.slo.SwapRecord` (it was
+        PREEMPTED while decoding — ISSUE 20) resumes through
+        :meth:`_swap_in` instead of prefilling; if it was cancelled or
+        expired while parked, it completes with the tokens it earned
+        BEFORE the preemption (a preempted request is started work, not
+        unstarted)."""
+        rec = (
+            self._swapped.pop(req.request_id, None)
+            if self._slo else None
+        )
         if req.request_id in self._cancelled:
             self._cancelled.discard(req.request_id)
             self.n_cancelled += 1
-            return [self._complete_unstarted(req, "cancelled")]
+            return [self._bounce(req, rec, "cancelled")]
         dl = self._deadline_for(req)
         if dl is not None and time.perf_counter() - req.submitted_s > dl:
             self.n_deadline_expired += 1
             if self._flight is not None:
                 self._flight.fault("deadline", rid=req.request_id)
-            return [self._complete_unstarted(req, "deadline")]
+            return [self._bounce(req, rec, "deadline")]
         aid = int(getattr(req, "adapter", 0))
         if aid and not (
             self._bank.registry.is_live(aid)
@@ -2057,9 +2446,11 @@ class ServeEngine:
                 self._flight.fault(
                     "adapter_evicted", rid=req.request_id, adapter=aid
                 )
-            return [self._complete_unstarted(req, "adapter_evicted")]
+            return [self._bounce(req, rec, "adapter_evicted")]
         if aid:
             self.adapter_requests += 1
+        if rec is not None:
+            return self._swap_in(slot, req, rec)
         if self._role == "decode":
             # disaggregated refill (ISSUE 18): the prefill already ran
             # on another engine — splice its transferred segment in
@@ -2881,6 +3272,17 @@ class ServeEngine:
             )
         return comp
 
+    def _bounce(self, req: Request, rec, reason: str) -> Completion:
+        """Boundary completion for a request the refill lifecycle checks
+        reject: zero-work (:meth:`_complete_unstarted`) for a request
+        that never started, but a PREEMPTED request (carrying a
+        :class:`..serve.slo.SwapRecord`) keeps the tokens it earned
+        before the swap — preemption must never silently discard
+        delivered progress."""
+        if rec is not None:
+            return self._complete(rec.active, reason)
+        return self._complete_unstarted(req, reason)
+
     def _complete(self, act: _Active, reason: str) -> Completion:
         if act.segment is not None:
             # the slot no longer decodes from this segment's splice;
@@ -3130,9 +3532,26 @@ class ServeEngine:
             return {"sentry": 0}
         return self._sentry.summary()
 
+    def slo_stats(self) -> dict[str, int | float]:
+        """SLO-tier fields for the receipt (ISSUE 20):
+        ``priority_classes`` / ``preemption`` are config (regress.py
+        fingerprints both so SLO rounds never gate FIFO rounds); the
+        swap counters are outcomes (excluded from the fingerprint).
+        ``{"priority_classes": 0}`` when off."""
+        if not self._slo:
+            return {"priority_classes": 0}
+        return {
+            "priority_classes": self._n_classes,
+            "preemption": 1,
+            "n_preemptions": self.n_swaps_out,
+            "n_swaps_out": self.n_swaps_out,
+            "n_swaps_in": self.n_swaps_in,
+            "swapped_now": len(self._swapped),
+        }
+
     _STATS_PARTS = (
         "prefix", "spec", "adapters", "fault", "flight", "pipeline",
-        "pages", "tp", "role", "sentry",
+        "pages", "tp", "role", "sentry", "slo",
     )
 
     def stats(self, *parts: str) -> dict[str, int | float]:
@@ -3161,6 +3580,7 @@ class ServeEngine:
             "tp": self.tp_stats,
             "role": self.role_stats,
             "sentry": self.sentry_stats,
+            "slo": self.slo_stats,
         }
         out: dict[str, int | float] = {}
         for part in self._STATS_PARTS:

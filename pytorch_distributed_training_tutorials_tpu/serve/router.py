@@ -313,7 +313,18 @@ class FleetRouter:
     engines: the N replicas. Replica index = position in this list.
     affinity_depth: prompt-prefix tokens feeding :func:`affinity_hash`.
     hedge_after_s: duplicate a request stuck on a SUSPECT replica after
-        this many seconds (None = hedging off, the default).
+        this many seconds (None = hedging off, the default). A dict maps
+        SLO class -> threshold (ISSUE 20): interactive class 0 hedges
+        aggressively while batch classes wait longer (a class missing
+        from the map never hedges) — the per-request class comes from
+        ``Request.priority``.
+    class_deadline_s: per-SLO-class default deadline (ISSUE 20): a dict
+        mapping ``Request.priority`` -> seconds, stamped onto a
+        submission whose own ``deadline_s`` is None (an explicit
+        per-request deadline always wins; classes missing from the map
+        fall through to the engine's ``default_deadline_s``). Stamped
+        BEFORE the re-dispatch template is frozen, so a request moved
+        off a dead replica keeps its class deadline.
     suspect_after_s / dead_after_s: heartbeat ages (no observable
         progress while non-idle) that demote healthy -> suspect ->
         dead.
@@ -336,7 +347,8 @@ class FleetRouter:
 
     def __init__(self, engines: List[Any], *,
                  affinity_depth: int = 16,
-                 hedge_after_s: Optional[float] = None,
+                 hedge_after_s: Any = None,
+                 class_deadline_s: Optional[Dict[int, float]] = None,
                  suspect_after_s: float = 1.0,
                  dead_after_s: float = 5.0,
                  fault_streak: int = 3,
@@ -366,7 +378,16 @@ class FleetRouter:
                     f"AND one decode replica (roles={roles})"
                 )
         self._affinity_depth = int(affinity_depth)
-        self._hedge_after_s = hedge_after_s
+        if isinstance(hedge_after_s, dict):
+            self._hedge_after_s = {
+                int(k): float(v) for k, v in hedge_after_s.items()
+            }
+        else:
+            self._hedge_after_s = hedge_after_s
+        self._class_deadline_s = (
+            {int(k): float(v) for k, v in class_deadline_s.items()}
+            if class_deadline_s else None
+        )
         self._suspect_after_s = float(suspect_after_s)
         self._dead_after_s = float(dead_after_s)
         self._fault_streak_limit = int(fault_streak)
@@ -440,6 +461,15 @@ class FleetRouter:
         if self._closed:
             raise QueueClosed("fleet router is closed")
         template = dataclasses.replace(request)
+        if (self._class_deadline_s is not None
+                and template.deadline_s is None):
+            # class-indexed deadline policy (ISSUE 20): stamped on the
+            # TEMPLATE, so every dispatch clone — including re-dispatch
+            # off a dead replica — carries the same class deadline; an
+            # explicit per-request deadline_s always wins
+            template.deadline_s = self._class_deadline_s.get(
+                int(getattr(template, "priority", 0))
+            )
         now = self._clock()
         probe = self._probe_candidate(now, role="prefill")
         order = ([probe] if probe is not None else []) + self._route_order(
@@ -974,6 +1004,17 @@ class FleetRouter:
 
     # -- hedging -----------------------------------------------------------
 
+    def _hedge_threshold(self, gid: int) -> Optional[float]:
+        """The hedge age for this request: the scalar config, or — when
+        ``hedge_after_s`` is a class-indexed map (ISSUE 20) — the
+        request's SLO-class entry (None = that class never hedges)."""
+        if not isinstance(self._hedge_after_s, dict):
+            return self._hedge_after_s
+        req = self._requests.get(gid)
+        return self._hedge_after_s.get(
+            int(getattr(req, "priority", 0)) if req is not None else 0
+        )
+
     def _maybe_hedge(self, now: float) -> None:
         if self._hedge_after_s is None:
             return
@@ -992,8 +1033,11 @@ class FleetRouter:
             rep = self._replicas[rep_i]
             if rep.state != SUSPECT:
                 continue
+            threshold = self._hedge_threshold(gid)
+            if threshold is None:
+                continue
             age = now - entry.dispatches[-1][3]
-            if age < self._hedge_after_s:
+            if age < threshold:
                 continue
             target = self._place(
                 self._requests[gid], gid, kind="hedge",
@@ -1038,9 +1082,21 @@ class FleetRouter:
         the fingerprint, mirroring the chaos precedent."""
         states = self.replica_states()
         roles = [r.role for r in self._replicas]
+        if isinstance(self._hedge_after_s, dict):
+            # class-indexed hedging (ISSUE 20): serialized as a stable
+            # "class:seconds" string so the fingerprint stays hashable
+            hedge: Any = ",".join(
+                f"{k}:{v}" for k, v in sorted(self._hedge_after_s.items())
+            )
+        else:
+            hedge = float(self._hedge_after_s or 0.0)
         return {
             "n_replicas": self.n_replicas,
-            "hedge": float(self._hedge_after_s or 0.0),
+            "hedge": hedge,
+            "class_deadline_s": ",".join(
+                f"{k}:{v}"
+                for k, v in sorted((self._class_deadline_s or {}).items())
+            ),
             "affinity": self._affinity_depth,
             # disaggregation geometry (ISSUE 18): config, fingerprinted
             # by regress.py; 0/0 = monolithic fleet
@@ -1078,6 +1134,10 @@ class FleetRouter:
         # geometry lives in router_stats' n_prefill/n_decode_replicas);
         # the handoff counters below it stay counters and SUM
         "role",
+        # SLO tiers (ISSUE 20): class count and the preemption flag are
+        # engine geometry (identical across a homogeneous fleet); the
+        # swap counters stay counters and SUM
+        "priority_classes", "preemption",
     })
     # Derived ratios: recomputed or dropped rather than summed.
     _RATIO_STAT_KEYS = frozenset({
@@ -1107,7 +1167,7 @@ class FleetRouter:
                     continue
                 if flight is not None and k.startswith((
                     "flight", "ttft_", "e2e_", "queue_wait_",
-                    "chain_util_", "chain_overlap_",
+                    "chain_util_", "chain_overlap_", "preempt_wait_",
                 )):
                     continue  # superseded by the histogram merge
                 if sentry is not None and k.startswith("sentry"):

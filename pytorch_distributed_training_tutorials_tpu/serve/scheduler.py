@@ -60,6 +60,16 @@ class Request:
     next chain/refill boundary (partial tokens kept — never a mid-chain
     interrupt). ``None`` falls back to the engine's
     ``default_deadline_s`` (itself ``None`` = no deadline).
+
+    ``priority`` is the request's SLO class (ISSUE 20): 0 = highest,
+    larger = lower tier. Validated at submit against the scheduler's
+    class count — this FIFO scheduler admits only class 0 (one class),
+    :class:`..serve.slo.PriorityScheduler` widens the range — the same
+    synchronous admission contract as the deadline/window checks. Under
+    a priority engine a lower-tier active request may be PREEMPTED (its
+    KV swapped to host) for a higher-tier waiter and later resumed
+    token-exact; priority never changes results, only ordering and
+    preemption eligibility.
     """
 
     prompt: Any
@@ -68,6 +78,7 @@ class Request:
     eos_token: int | None = None
     adapter: int = 0
     deadline_s: float | None = None
+    priority: int = 0
     # engine-assigned bookkeeping (not caller inputs)
     request_id: int = -1
     submitted_s: float = 0.0
@@ -149,6 +160,13 @@ class FifoScheduler:
     could write outside its fixed-shape slot.
     """
 
+    # SLO classes this scheduler admits: [0, n_classes). The FIFO
+    # scheduler is the single-class baseline; PriorityScheduler
+    # (serve/slo.py) widens it. Submit validates against this, so a
+    # nonzero priority on a FIFO engine is a synchronous ValueError —
+    # admission-validated like deadlines, never a silent ignore.
+    n_classes = 1
+
     def __init__(self, window: int, max_queue: int = 64):
         if window < 1 or max_queue < 1:
             raise ValueError(f"window/max_queue must be >= 1, got "
@@ -190,6 +208,13 @@ class FifoScheduler:
             raise ValueError("max_new_tokens must be >= 1")
         if request.deadline_s is not None and request.deadline_s <= 0:
             raise ValueError("deadline_s must be > 0 (None = no deadline)")
+        prio = int(getattr(request, "priority", 0))
+        if not 0 <= prio < self.n_classes:
+            raise ValueError(
+                f"priority {prio} outside [0, {self.n_classes}); this "
+                "scheduler admits only these SLO classes (use a "
+                "PriorityScheduler engine for multi-class traffic)"
+            )
         if p_len + request.max_new_tokens > self.window:
             raise ValueError(
                 f"prompt ({p_len}) + max_new_tokens "

@@ -1,0 +1,180 @@
+"""SLO tiers: priority scheduling + the host side of KV-swap preemption.
+
+ISSUE 20's traffic-shaping subsystem. Every request today rides one FIFO
+class, so interactive traffic cannot hold its TTFT p95 while batch
+traffic absorbs variance. This module is the POLICY half of the fix —
+deliberately jax-free (HOST_ONLY_MODULES + the no-jax subprocess pin),
+like the scheduler it extends: which request pops next, which active
+slot is preempted, and the host-side record a swapped-out request parks
+in are all pure Python. The MECHANISM half (the budgeted swap-out fetch,
+the ``seed_cache``/``write_slot`` swap-in splice) lives in
+:mod:`.engine` / :mod:`.slots`, where jax belongs.
+
+Three pieces:
+
+- :class:`PriorityScheduler` — pops by (class, arrival): class 0 is the
+  highest tier, within a class strict arrival order, and the existing
+  ``chunk=``/``pending_long=``/``fits=`` predicates apply unchanged (a
+  high-class request that does not fit stays queued and a lower class
+  may pop around it — pages freeing up, not priority, is what unblocks
+  it). With ``n_classes=1`` every pop reduces to the first passing
+  candidate in arrival order — order-identical to
+  :class:`.scheduler.FifoScheduler` (tests/test_slo.py pins it).
+- :func:`choose_victim` — the preemption policy: the engine may evict an
+  active slot only for a STRICTLY higher waiting class, picks the
+  numerically greatest (lowest-tier) active class, and among equals the
+  most recently admitted request (largest id) — oldest work keeps its
+  progress.
+- :class:`SwapRecord` — the parked state of a preempted request: the
+  engine's host-side active record (generated tokens kept), the fetched
+  cache segment + sampling leaves, and the position/bucket needed to
+  re-splice. It is the :class:`.scheduler.Handoff` idea pointed at host
+  instead of a decode replica: leaves here are host numpy (the swap-out
+  fetch already paid for them), so holding a record costs HBM nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Optional
+
+from .scheduler import FifoScheduler, Request
+
+
+class PriorityScheduler(FifoScheduler):
+    """Bounded multi-class queue: pop by (priority class, arrival).
+
+    ``n_classes`` fixes the admission range at construction —
+    ``Request.priority`` must satisfy ``0 <= priority < n_classes`` or
+    :meth:`~.scheduler.FifoScheduler.submit` raises ``ValueError``, the
+    same synchronous admission contract as the window/deadline checks
+    (the base class enforces it; this class only widens ``n_classes``).
+    One arrival-ordered deque backs every class: a pop scans for the
+    best (lowest) class passing the predicates, tie-broken by arrival,
+    so within a class the FIFO fairness story is unchanged and a
+    single-class instance is order-identical to the FIFO scheduler.
+    """
+
+    def __init__(self, window: int, max_queue: int = 64,
+                 n_classes: int = 2):
+        if n_classes < 1:
+            raise ValueError(f"n_classes must be >= 1, got {n_classes}")
+        super().__init__(window, max_queue=max_queue)
+        self.n_classes = n_classes
+
+    def pop(self, chunk: int = 0, pending_long: int = 0,
+            fits=None) -> Request | None:
+        """Best (class, arrival) request passing the predicates, or None.
+
+        Predicate semantics are exactly the FIFO scheduler's: with
+        ``chunk`` set and a long prompt mid chunked-prefill only
+        single-chunk prompts are eligible, and ``fits`` filters on top.
+        Among eligible requests the lowest ``priority`` wins; within a
+        class, earliest arrival (the scan early-exits on the first
+        class-0 candidate — arrival order IS deque order)."""
+        if not self._queue:
+            return None
+        best: tuple[int, int] | None = None  # (priority, deque index)
+        for i, r in enumerate(self._queue):
+            if chunk and pending_long and len(r.prompt) > chunk:
+                continue
+            if fits is not None and not fits(r):
+                continue
+            p = int(getattr(r, "priority", 0))
+            if best is None or p < best[0]:
+                best = (p, i)
+                if p == 0:
+                    break
+        if best is None:
+            return None
+        req = self._queue[best[1]]
+        del self._queue[best[1]]
+        return req
+
+    def requeue(self, request: Request) -> None:
+        """Re-insert a PREEMPTED request, keeping the deque sorted by
+        arrival (``request_id`` is the admission counter, so id order is
+        arrival order). Bypasses ``QueueFull``/``QueueClosed``
+        deliberately: the request was already admitted once — preemption
+        must never turn an accepted request into a shed one (the same
+        no-accepted-request-dropped contract as ``drain``)."""
+        idx = len(self._queue)
+        for i, r in enumerate(self._queue):
+            if r.request_id > request.request_id:
+                idx = i
+                break
+        self._queue.insert(idx, request)
+
+    def peek_priority(self) -> Optional[int]:
+        """Best (numerically smallest) waiting class, or None when
+        empty — the engine's pressure signal: preemption is considered
+        only when this class outranks an active slot's."""
+        if not self._queue:
+            return None
+        return min(int(getattr(r, "priority", 0)) for r in self._queue)
+
+    def peek_request(self) -> Request | None:
+        """The request a bare predicate-free :meth:`pop` would return,
+        WITHOUT removing it — the paged engine inspects its page need to
+        decide whether pool pressure (rather than slot pressure) calls
+        for a preemption."""
+        if not self._queue:
+            return None
+        best = None
+        for r in self._queue:
+            p = int(getattr(r, "priority", 0))
+            if best is None or p < best[0]:
+                best = (p, r)
+                if p == 0:
+                    break
+        return best[1]
+
+
+def choose_victim(active: Iterable[tuple[int, int, int]],
+                  waiting_class: int) -> Optional[int]:
+    """Pick the slot to preempt for a ``waiting_class`` request, or None.
+
+    ``active`` yields ``(slot, priority, request_id)`` for every
+    occupied slot. Only a slot whose class is STRICTLY lower-tier
+    (numerically greater) than ``waiting_class`` is eligible — equal
+    classes never preempt each other (arrival order already arbitrates
+    within a class, and allowing ties would thrash). Among eligible
+    slots the numerically greatest class loses first; ties break toward
+    the most recently admitted request (largest id), so the oldest work
+    keeps its accumulated decode progress."""
+    victim: tuple[int, int, int] | None = None
+    for slot, prio, rid in active:
+        if prio <= waiting_class:
+            continue
+        if victim is None or (prio, rid) > (victim[1], victim[2]):
+            victim = (slot, prio, rid)
+    return None if victim is None else victim[0]
+
+
+@dataclasses.dataclass
+class SwapRecord:
+    """A preempted request's parked state, host side (ISSUE 20).
+
+    ``active`` is the engine's own ``_Active`` record — request, tokens
+    generated so far, tokens remaining — kept whole so resume is a
+    reinstatement, not a reconstruction. ``segment`` / ``last_tok`` /
+    ``key`` (and ``hist`` / ``hist_len`` when speculation is on) are the
+    HOST-fetched leaves of the slot at the swap boundary: the cache
+    segment covers positions ``[0, position)`` at the pow2 bucket
+    ``seg_len`` (the same bucket family prefill/splice compile against,
+    so swap-in never mints a compile), ``last_tok`` is the next decode
+    input and ``key`` the request's PRNG stream mid-sequence — exactly
+    the :class:`.scheduler.Handoff` payload plus progress, fetched
+    instead of device-resident because the whole point is returning the
+    HBM to the pool. ``preempt_t`` stamps the swap for the flight
+    recorder's preempted-wait histogram."""
+
+    active: Any
+    segment: Any
+    last_tok: Any
+    key: Any
+    position: int
+    seg_len: int
+    hist: Any = None
+    hist_len: Any = None
+    preempt_t: float = 0.0

@@ -80,6 +80,13 @@ class ChaosConfig:
     - ``stall_chain`` / ``stall_s``: sleep ``stall_s`` seconds before
       dispatching chain index ``stall_chain`` — a deterministic stand-in
       for the multi-second launch stalls CLAUDE.md documents.
+    - ``preempt_slot`` / ``preempt_at_chain``: force the SLO engine to
+      preempt that slot (KV swap-out to host) at the chain-boundary
+      check once its chain counter reaches ``preempt_at_chain`` — the
+      swap path is testable without manufacturing real pool pressure.
+      Fires exactly ONCE (the engine latches the firing); the victim
+      resumes through the ordinary swap-in path, token-exact. Requires
+      ``priority_classes > 0`` on the engine; ignored otherwise.
     - ``seed`` rides into receipts/fingerprints so chaos runs are
       self-describing; the injectors themselves are deterministic.
     """
@@ -94,6 +101,8 @@ class ChaosConfig:
     fail_prefill_request: int = -1
     stall_chain: int = -1
     stall_s: float = 0.0
+    preempt_slot: int = -1
+    preempt_at_chain: int = -1
     seed: int = 0
 
     @property
@@ -119,6 +128,10 @@ class ChaosConfig:
     @property
     def stalls(self) -> bool:
         return self.stall_chain >= 0 and self.stall_s > 0
+
+    @property
+    def preempts(self) -> bool:
+        return self.preempt_slot >= 0 and self.preempt_at_chain >= 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -140,6 +140,28 @@ def _sentry_annotations(events: list[dict]) -> dict[int, str]:
     return notes
 
 
+def _slo_annotations(events: list[dict]) -> dict[int, str]:
+    """SLO-tier preemption lines (ISSUE 20): a ``preempt`` is a
+    lower-class slot's KV swapping out to host to make room for a
+    higher-class waiter, and the matching ``resume`` is its swap-in
+    re-splice. Both are rare and load-bearing on a mixed-class
+    timeline — flag them inline like the health transitions, with the
+    park position (how far decoding got) and the measured wait."""
+    notes: dict[int, str] = {}
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "preempt":
+            notes[id(ev)] = (
+                f" [preempted: slot {ev.get('slot', '?')} parked at "
+                f"position {ev.get('position', '?')}]"
+            )
+        elif kind == "resume":
+            notes[id(ev)] = (
+                f" [resumed after {ev.get('wait_s', '?')}s swapped out]"
+            )
+    return notes
+
+
 def _journey_filter(snap: dict, gid: int) -> dict:
     """Cut a merged fleet snapshot down to ONE request's cross-replica
     journey (ISSUE 19): events and spans the router's gid stitching
@@ -214,6 +236,7 @@ def render(snap: dict, index: int, max_events: int) -> None:
     notes.update(_health_annotations(snap["events"]))
     notes.update(_pool_annotations(snap["events"]))
     notes.update(_sentry_annotations(snap["events"]))
+    notes.update(_slo_annotations(snap["events"]))
     print(f"\nevents (last {min(max_events, len(snap['events']))}):")
     for ev in snap["events"][-max_events:]:
         print(_fmt_event(ev, trigger, notes.get(id(ev), "")))

@@ -6,10 +6,10 @@ fused train-step tail, the --server base arm, prefix splicing,
 speculation, multi-tenant adapters, deadlines, the flight recorder,
 request-loop pipelining, the fleet router, the paged KV pool,
 tensor-parallel serving, the fused paged-attention kernel with int4
-KV, and now prefill/decode disaggregation. This script is the
-catch-up: it sequences all thirteen arms so the next session with a
-chip runs ONE command instead of re-deriving thirteen recipes from
-CLAUDE.md prose.
+KV, prefill/decode disaggregation, and now SLO-tier preemption. This
+script is the catch-up: it sequences all fourteen arms so the next
+session with a chip runs ONE command instead of re-deriving fourteen
+recipes from CLAUDE.md prose.
 
 Sequencing is the point — every serving arm shares one --ckpt_dir, so
 the ~10-min cold 1.2B quantize-on-load cost is paid exactly once (by
@@ -65,6 +65,7 @@ ARM_NAMES = (
     "paged_int4",  # --kv-bits 4 --paged-kernel: 2x pages, fused reads
     "tp",          # --tp 4: head-sharded decode, per-chip KV at 1/tp
     "disagg",      # --disaggregate 1p2d: role-split fleet, handoff TTFT
+    "slo",         # --slo --qps 8: priority classes, preempt/resume wait
 )
 
 
@@ -132,6 +133,13 @@ def build_session(round_no: int, ckpt_dir: str, out_dir: str):
         # handoffs_moved == requests, and ledger_ok=true — decode tok/s
         # itself should match the fleet arm
         srv("disagg", "--disaggregate", "1p2d", "--qps", "8"),
+        # SLO-tier arm (ISSUE 20): two priority classes over one engine;
+        # high-class arrivals preempt the lowest-class active slot at
+        # the chain boundary (KV swap to host, resume token-exact). The
+        # interesting fields are per-class ttft_p95 split (class 0 flat
+        # under class-1 load), n_preemptions > 0 only at saturation,
+        # and preempt_wait_p95 — aggregate tok/s should match base
+        srv("slo", "--slo", "--qps", "8"),
     ]
 
 

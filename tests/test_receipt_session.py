@@ -1,7 +1,7 @@
 """scripts/receipt_session.py builds the deferred-receipt runbook.
 
 The script's job is sequencing, not measuring — so the CPU pin is that
-it builds exactly the thirteen documented recipes (CLAUDE.md's "receipt
+it builds exactly the fourteen documented recipes (CLAUDE.md's "receipt
 has NOT been taken yet" list) with one shared checkpoint dir and
 round-stamped output names, without importing jax or needing a chip.
 """
@@ -26,11 +26,11 @@ def _load():
     return mod
 
 
-def test_plan_covers_all_thirteen_deferred_arms():
+def test_plan_covers_all_fourteen_deferred_arms():
     mod = _load()
     plan = mod.build_session(6, "/ckpt", "/out")
     names = [n for n, _ in plan]
-    assert names == list(mod.ARM_NAMES) and len(names) == 13
+    assert names == list(mod.ARM_NAMES) and len(names) == 14
 
     cmds = dict(plan)
     # every serving arm shares the ONE checkpoint cache and is a
@@ -78,6 +78,12 @@ def test_plan_covers_all_thirteen_deferred_arms():
     dg = cmds["disagg"]
     assert dg[dg.index("--disaggregate") + 1] == "1p2d"
     assert dg[dg.index("--qps") + 1] == "8"
+    # the SLO arm (ISSUE 20): priority classes over one engine under
+    # open-loop load — preemption only fires when arrivals contend
+    slo = cmds["slo"]
+    assert "--slo" in slo
+    assert slo[slo.index("--qps") + 1] == "8"
+    assert "--replicas" not in slo and "--disaggregate" not in slo
 
 
 def test_only_filter_and_unknown_arm():
@@ -96,7 +102,8 @@ def test_dry_run_subprocess_prints_plan_without_running():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [ln for ln in out.stdout.splitlines() if ln.startswith("[")]
-    assert len(lines) == 13
+    assert len(lines) == 14
+    assert any("SERVING_r99_slo.json" in ln for ln in lines)
     assert any("SERVING_r99_tp.json" in ln for ln in lines)
     assert any("SERVING_r99_disagg.json" in ln for ln in lines)
     assert any("SERVING_r99_paged.json" in ln for ln in lines)

@@ -1,0 +1,521 @@
+"""SLO tiers (ISSUE 20): priority scheduling + preemption by KV swap.
+
+The policy half (``serve/slo.py`` — jax-free, pinned in the no-jax
+subprocess test alongside the scheduler) and the mechanism half (the
+engine's budgeted swap-out fetch + the ``seed_cache``/``write_slot``
+swap-in splice) each get their own pins here:
+
+- a single-class :class:`PriorityScheduler` is ORDER-identical to
+  :class:`FifoScheduler` under every predicate combination, and a
+  ``priority_classes=0`` engine is byte-identical to the pre-SLO build
+  (state tree, compiled-program census, no swap attrs) — the off-path
+  regression the satellite list names first;
+- admission validates ``Request.priority`` synchronously at submit
+  (like the window/deadline checks); ``requeue`` re-inserts a preempted
+  request at its ARRIVAL position and deliberately bypasses
+  ``QueueFull``/``QueueClosed`` (an accepted request is never shed);
+- :func:`choose_victim` evicts only strictly lower tiers, greatest
+  class first, ties toward the most recent admit;
+- the preempt → park → resume roundtrip is token-exact to an
+  undisturbed engine across the unrolled / ``scan_layers`` / GQA /
+  int8-KV layouts (engine-vs-engine stays bitwise even quantized: the
+  swap moves rounded cache values verbatim, recomputing nothing) and
+  through the paged pool-pressure trigger;
+- the chaos ``preempt_at_chain`` injector forces the same path exactly
+  once, tokens unchanged;
+- the fetch budget grows by EXACTLY the counted swap-outs (swap-in
+  re-splices on device and fetches nothing);
+- the flight recorder sees paired ``preempt``/``resume`` events and a
+  populated preempted-wait histogram.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_training_tutorials_tpu.models.generate import generate
+from pytorch_distributed_training_tutorials_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from pytorch_distributed_training_tutorials_tpu.serve import (
+    FifoScheduler,
+    PriorityScheduler,
+    Request,
+    ServeEngine,
+)
+from pytorch_distributed_training_tutorials_tpu.serve.scheduler import (
+    QueueFull,
+)
+from pytorch_distributed_training_tutorials_tpu.serve.slo import (
+    choose_victim,
+)
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=4, max_seq_len=64
+)
+
+
+def _make(cfg=CFG, seed=0):
+    model = TransformerLM(cfg)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def _prompt(seed, p_len, vocab=CFG.vocab_size):
+    return jax.device_get(
+        jax.random.randint(jax.random.PRNGKey(seed), (p_len,), 0, vocab)
+    ).tolist()
+
+
+def _reference(model, params, prompt, max_new):
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32), max_new)
+    return jax.device_get(out)[0, len(prompt):].tolist()
+
+
+def _tree_identical(a, b):
+    fa, ta = jax.tree_util.tree_flatten(a)
+    fb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb:
+        return False
+    return all(
+        x.shape == y.shape and x.dtype == y.dtype
+        and bool(jnp.all(x == y))
+        for x, y in zip(fa, fb)
+    )
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    return _make()
+
+
+def _host_req(prio=0, p_len=3, max_new=4):
+    """Scheduler-only request: plain-list prompt, no jax needed."""
+    return Request(
+        prompt=list(range(1, p_len + 1)), max_new_tokens=max_new,
+        priority=prio,
+    )
+
+
+# --------------------------------------------- the single-class FIFO identity
+
+def test_single_class_pop_order_identical_to_fifo():
+    """The satellite regression pin: with ``n_classes=1`` every pop
+    reduces to the first passing candidate in arrival order, so the
+    PriorityScheduler is ORDER-identical to the FifoScheduler — plain
+    pops, ``fits=``-filtered pops, and the chunked-prefill
+    ``chunk=``/``pending_long=`` predicate all included."""
+    lengths = [5, 9, 3, 12, 7, 10, 4]
+
+    def fill(sched):
+        ids = []
+        for p in lengths:
+            ids.append(sched.submit(_host_req(p_len=p)))
+        return ids
+
+    def fresh_pair():
+        return (FifoScheduler(window=64, max_queue=16),
+                PriorityScheduler(window=64, max_queue=16, n_classes=1))
+
+    # plain pops
+    fifo, prio = fresh_pair()
+    fill(fifo)
+    fill(prio)
+    assert ([fifo.pop().request_id for _ in lengths]
+            == [prio.pop().request_id for _ in lengths])
+    assert fifo.pop() is None and prio.pop() is None
+
+    # fits= predicate (the paged pool's page-availability filter)
+    fifo, prio = fresh_pair()
+    fill(fifo)
+    fill(prio)
+
+    def fits(r):
+        return len(r.prompt) <= 7
+
+    got_f = [fifo.pop(fits=fits) for _ in range(4)]
+    got_p = [prio.pop(fits=fits) for _ in range(4)]
+    assert ([r.request_id for r in got_f if r]
+            == [r.request_id for r in got_p if r])
+
+    # chunk=/pending_long= (a long prompt mid chunked-prefill: only
+    # single-chunk prompts are eligible)
+    fifo, prio = fresh_pair()
+    fill(fifo)
+    fill(prio)
+    got_f = [fifo.pop(chunk=8, pending_long=1) for _ in range(5)]
+    got_p = [prio.pop(chunk=8, pending_long=1) for _ in range(5)]
+    assert ([r.request_id for r in got_f if r]
+            == [r.request_id for r in got_p if r])
+
+
+def test_multi_class_pop_order():
+    """Pops come by (class, arrival): all class-0 work in arrival order,
+    then class 1, then class 2 — never reordered within a class."""
+    sched = PriorityScheduler(window=64, n_classes=3)
+    prios = [2, 1, 2, 0, 1, 0]
+    rids = [sched.submit(_host_req(prio=p)) for p in prios]
+    got = [sched.pop().request_id for _ in rids]
+    want = [rid for _, rid in sorted(
+        ((p, rid) for p, rid in zip(prios, rids)),
+        key=lambda t: (t[0], t[1]),
+    )]
+    assert got == want
+    assert sched.pop() is None
+
+
+def test_priority_admission_validated_at_submit():
+    """Out-of-range classes raise synchronously at submit (the same
+    admission contract as the window/deadline checks); the FIFO default
+    is a single class, so any nonzero priority is rejected there too —
+    an engine without ``priority_classes`` can never quietly accept
+    tiered traffic it would then ignore."""
+    sched = PriorityScheduler(window=64, n_classes=2)
+    with pytest.raises(ValueError):
+        sched.submit(_host_req(prio=2))
+    with pytest.raises(ValueError):
+        sched.submit(_host_req(prio=-1))
+    sched.submit(_host_req(prio=1))  # in range: fine
+
+    fifo = FifoScheduler(window=64)
+    with pytest.raises(ValueError):
+        fifo.submit(_host_req(prio=1))
+
+    with pytest.raises(ValueError):
+        PriorityScheduler(window=64, n_classes=0)
+
+
+def test_requeue_bypasses_backpressure_keeps_arrival_order():
+    """A preempted request re-enters at its ARRIVAL position (id order)
+    and requeue never sheds: it bypasses ``QueueFull`` (the queue was
+    sized for admissions, not returns) and works after ``close()`` —
+    preemption must never turn an accepted request into a dropped one."""
+    sched = PriorityScheduler(window=64, max_queue=2, n_classes=2)
+    a, b = _host_req(prio=1), _host_req(prio=1)
+    sched.submit(a)
+    sched.submit(b)
+    popped = sched.pop()
+    assert popped is a
+    sched.submit(_host_req(prio=1))  # queue full again
+    with pytest.raises(QueueFull):
+        sched.submit(_host_req(prio=1))
+    sched.requeue(a)  # over capacity, deliberately accepted
+    assert len(sched) == 3
+    # arrival order restored: a admitted first, so a pops first
+    assert sched.pop() is a
+    sched.close()
+    sched.requeue(a)  # closed queues still take returns
+    assert sched.pop() is a
+
+
+def test_peek_priority_and_peek_request():
+    sched = PriorityScheduler(window=64, n_classes=3)
+    assert sched.peek_priority() is None and sched.peek_request() is None
+    sched.submit(_host_req(prio=2))
+    r1 = _host_req(prio=1, p_len=5)
+    sched.submit(r1)
+    assert sched.peek_priority() == 1
+    assert sched.peek_request() is r1
+    assert len(sched) == 2  # peeks never remove
+
+
+def test_choose_victim_policy():
+    """Strictly-lower-tier only (equal classes never preempt each
+    other), numerically greatest class loses first, ties break toward
+    the most recently admitted request — oldest work keeps its
+    progress."""
+    assert choose_victim([], waiting_class=0) is None
+    # no strictly lower tier than the waiter: nothing eligible
+    assert choose_victim([(0, 1, 5), (1, 1, 6)], waiting_class=1) is None
+    assert choose_victim([(0, 0, 1), (1, 0, 2)], waiting_class=0) is None
+    # greatest class loses first
+    assert choose_victim([(0, 1, 5), (1, 2, 3)], waiting_class=0) == 1
+    # within a class, largest request_id (newest admit) loses
+    assert choose_victim([(0, 1, 5), (1, 1, 9), (2, 1, 7)], 0) == 1
+    # mixed: class outranks recency
+    assert choose_victim([(0, 2, 1), (1, 1, 99)], waiting_class=0) == 0
+
+
+# ----------------------------------------------------- engine off-path pins
+
+def test_priority_off_engine_byte_identical(model_params):
+    """``priority_classes=0`` (the default) is the pre-SLO engine
+    byte-for-byte: FIFO scheduler, identical slot-state tree and
+    compiled-program census after the same stream, and none of the swap
+    attrs exist (no jit twins constructed, no counters)."""
+    model, params = model_params
+    base = ServeEngine(model, params, n_slots=2, tokens_per_launch=8)
+    off = ServeEngine(model, params, n_slots=2, tokens_per_launch=8,
+                      priority_classes=0)
+    assert type(off.scheduler) is FifoScheduler
+    for attr in ("_swapped", "n_swaps_out", "n_swaps_in",
+                 "_swap_out_jit", "_swap_in_jit", "_chaos_preempt_fired"):
+        assert not hasattr(off, attr), attr
+    assert off.slo_stats() == {"priority_classes": 0}
+
+    reqs = [(3, 6), (9, 5), (6, 8)]
+    outs = []
+    for eng in (base, off):
+        ids = [
+            eng.submit(Request(
+                prompt=_prompt(7100 + i, p), max_new_tokens=m, seed=i,
+            ))
+            for i, (p, m) in enumerate(reqs)
+        ]
+        done = {c.request_id: c for c in eng.run_until_idle()}
+        outs.append([done[i].tokens for i in ids])
+    assert outs[0] == outs[1]
+    assert _tree_identical(base._state, off._state)
+    assert base._chain._cache_size() == off._chain._cache_size()
+    assert base._prefill._cache_size() == off._prefill._cache_size()
+
+
+def test_slo_engine_validation(model_params):
+    """Construction and admission guards: negative class counts and the
+    role combination are rejected at construction (preemption swaps are
+    decode-side machinery a role-split replica must not own), and an
+    out-of-range priority is synchronous submit backpressure."""
+    model, params = model_params
+    with pytest.raises(ValueError):
+        ServeEngine(model, params, n_slots=1, priority_classes=-1)
+    with pytest.raises(ValueError):
+        ServeEngine(model, params, n_slots=1, priority_classes=2,
+                    role="prefill")
+    eng = ServeEngine(model, params, n_slots=1, priority_classes=2)
+    with pytest.raises(ValueError):
+        eng.submit(Request(prompt=[1, 2], max_new_tokens=1, priority=2))
+    st = eng.slo_stats()
+    assert st["priority_classes"] == 2 and st["preemption"] == 1
+
+
+# ------------------------------------------- the preempt → resume roundtrip
+
+def _drive_preemption(model, params, prompts=None, **engine_kw):
+    """1 slot, a long class-1 request partially decoded, then a class-0
+    arrival: the engine must swap the class-1 slot out, serve the
+    class-0 request, and resume the victim. Returns (engine,
+    lo_completion, hi_completion). ``prompts`` lets a caller precompute
+    the (lo, hi) prompts outside a device_get spy window."""
+    lo_prompt, hi_prompt = prompts or (_prompt(7200, 3), _prompt(7201, 9))
+    engine = ServeEngine(model, params, n_slots=1, tokens_per_launch=8,
+                         priority_classes=2, **engine_kw)
+    lo_id = engine.submit(Request(
+        prompt=lo_prompt, max_new_tokens=17, seed=0, priority=1,
+    ))
+    engine.step()  # prefill + first chain: partial progress, slot busy
+    hi_id = engine.submit(Request(
+        prompt=hi_prompt, max_new_tokens=6, seed=1, priority=0,
+    ))
+    done = {c.request_id: c for c in engine.run_until_idle()}
+    return engine, done[lo_id], done[hi_id]
+
+
+@pytest.mark.parametrize(
+    "cfg_kwargs",
+    [
+        dict(),
+        pytest.param(dict(scan_layers=True), marks=pytest.mark.slow),
+        pytest.param(dict(n_kv_heads=2), marks=pytest.mark.slow),
+        pytest.param(dict(kv_cache_dtype="int8"), marks=pytest.mark.slow),
+    ],
+    ids=["unrolled", "scan_layers", "gqa", "int8_kv"],
+)
+def test_preempt_resume_token_exact_layouts(cfg_kwargs):
+    """The acceptance pin: a preempted-and-resumed greedy request is
+    token-exact to the undisturbed engine on every cache layout.
+    Engine-vs-engine stays BITWISE even for int8-KV — the swap moves the
+    rounded cache values verbatim (extract + seed + write recompute
+    nothing), so quantization never reassociates across the roundtrip.
+    Full-precision layouts additionally match one-shot generate()."""
+    cfg = dataclasses.replace(CFG, **cfg_kwargs)
+    model, params = _make(cfg)
+    engine, lo, hi = _drive_preemption(model, params)
+    assert engine.n_swaps_out == 1 and engine.n_swaps_in == 1
+    assert not engine._swapped  # nothing left parked
+    assert lo.finish_reason == "length" and len(lo.tokens) == 17
+
+    # undisturbed reference: the same engine config, one request at a
+    # time — no co-scheduling, no preemption
+    ref = ServeEngine(model, params, n_slots=1, tokens_per_launch=8)
+    ref.submit(Request(prompt=_prompt(7200, 3), max_new_tokens=17, seed=0))
+    (ref_lo,) = ref.run_until_idle()
+    ref.submit(Request(prompt=_prompt(7201, 9), max_new_tokens=6, seed=1))
+    (ref_hi,) = ref.run_until_idle()
+    assert lo.tokens == ref_lo.tokens
+    assert hi.tokens == ref_hi.tokens
+    if "kv_cache_dtype" not in cfg_kwargs:
+        assert lo.tokens == _reference(model, params, _prompt(7200, 3), 17)
+        assert hi.tokens == _reference(model, params, _prompt(7201, 9), 6)
+
+
+def test_preempt_priority_order_observed(model_params):
+    """The preemption is not just counted — the class-0 request actually
+    FINISHES before the resumed class-1 victim (that reordering is the
+    entire point of the tier)."""
+    model, params = model_params
+    engine, lo, hi = _drive_preemption(model, params)
+    assert engine.n_swaps_out == 1
+    assert hi.latency_s < lo.latency_s
+    st = engine.slo_stats()
+    assert st["n_preemptions"] == 1 and st["swapped_now"] == 0
+
+
+def test_preempt_paged_pool_pressure(model_params):
+    """The paged trigger: a FREE slot exists but the pool cannot back
+    the waiting class-0 request, so the class-1 slot is swapped out and
+    its pages return to the pool (allocation stays refill/splice-only —
+    the swap never allocates mid-decode). Token-exact to the undisturbed
+    paged engine; the pool drains to zero."""
+    model, params = model_params
+    geometry = dict(paged=True, page_size=8, pool_pages=4)
+    lo_prompt, hi_prompt = _prompt(7210, 3), _prompt(7211, 9)
+
+    engine = ServeEngine(model, params, n_slots=2, tokens_per_launch=8,
+                         priority_classes=2, **geometry)
+    lo_id = engine.submit(Request(
+        prompt=lo_prompt, max_new_tokens=17, seed=0, priority=1,
+    ))
+    engine.step()  # lo holds 3 of 4 pages; slot 1 is free
+    hi_id = engine.submit(Request(
+        prompt=hi_prompt, max_new_tokens=6, seed=1, priority=0,
+    ))  # needs 2 pages; only 1 available -> pool pressure
+    done = {c.request_id: c for c in engine.run_until_idle()}
+    assert engine.n_swaps_out == 1 and engine.n_swaps_in == 1
+
+    ref = ServeEngine(model, params, n_slots=2, tokens_per_launch=8,
+                      **geometry)
+    ref.submit(Request(prompt=lo_prompt, max_new_tokens=17, seed=0))
+    (ref_lo,) = ref.run_until_idle()
+    ref.submit(Request(prompt=hi_prompt, max_new_tokens=6, seed=1))
+    (ref_hi,) = ref.run_until_idle()
+    assert done[lo_id].tokens == ref_lo.tokens
+    assert done[hi_id].tokens == ref_hi.tokens
+    assert engine.page_stats()["pages_in_use"] == 0
+
+
+def test_chaos_preempt_at_chain_once_token_exact(model_params):
+    """The ``preempt_at_chain`` injector forces a named slot through the
+    real swap path exactly once — no queue pressure required — and the
+    tokens are identical to the clean engine's (a forced swap is
+    invisible in the stream, the same contract as organic preemption)."""
+    from pytorch_distributed_training_tutorials_tpu.utils.chaos import (
+        ChaosConfig,
+    )
+
+    model, params = model_params
+    reqs = [(3, 12), (7, 10)]
+
+    def run(chaos):
+        eng = ServeEngine(model, params, n_slots=2, tokens_per_launch=8,
+                          priority_classes=2, chaos=chaos)
+        ids = [
+            eng.submit(Request(
+                prompt=_prompt(7300 + i, p), max_new_tokens=m, seed=i,
+                priority=1,
+            ))
+            for i, (p, m) in enumerate(reqs)
+        ]
+        done = {c.request_id: c for c in eng.run_until_idle()}
+        return eng, [done[i].tokens for i in ids]
+
+    clean_eng, clean = run(None)
+    chaos_eng, chaotic = run(ChaosConfig(preempt_slot=0, preempt_at_chain=1))
+    assert clean_eng.n_swaps_out == 0
+    assert chaos_eng.n_swaps_out == 1 and chaos_eng.n_swaps_in == 1
+    assert chaotic == clean
+
+
+# -------------------------------------------------- budget + observability
+
+def test_slo_fetch_budget(model_params, monkeypatch):
+    """The budget line grows by EXACTLY the counted swap-outs: total
+    ``jax.device_get`` calls == chains + prefills + splices + swaps_out
+    (swap-in re-uploads parked host leaves and re-splices on device —
+    zero fetches)."""
+    model, params = model_params
+    prompts = (_prompt(7200, 3), _prompt(7201, 9))  # outside the spy
+    calls = {"n": 0}
+    real_get = jax.device_get
+    monkeypatch.setattr(
+        jax, "device_get",
+        lambda x: (calls.__setitem__("n", calls["n"] + 1), real_get(x))[1],
+    )
+    engine, lo, hi = _drive_preemption(model, params, prompts=prompts)
+    assert engine.n_swaps_out == 1
+    assert calls["n"] == (
+        engine.n_chains + engine.n_prefills + engine.n_splices
+        + engine.n_swaps_out
+    )
+
+
+def test_flight_preempt_resume_events(model_params):
+    """The recorder sees one ``preempt``/``resume`` pair naming the
+    victim's rid and slot, and the preempted-wait histogram carries the
+    measured swap-out span (host-only stamping — the budget pin above
+    already proved no extra fetch)."""
+    from pytorch_distributed_training_tutorials_tpu.obs.flight import (
+        FlightRecorder,
+    )
+
+    model, params = model_params
+    rec = FlightRecorder(capacity=256)
+    engine, lo, hi = _drive_preemption(model, params, flight=rec)
+    pre = [e for e in rec.events if e["kind"] == "preempt"]
+    res = [e for e in rec.events if e["kind"] == "resume"]
+    assert len(pre) == 1 and len(res) == 1
+    assert pre[0]["rid"] == res[0]["rid"] == lo.request_id
+    assert pre[0]["tokens"] > 0  # partial progress parked, not discarded
+    assert res[0]["wait_s"] >= 0.0
+    assert rec.hist["preempt_wait"].n == 1
+    assert "preempt_wait_p95_s" in rec.summary()
+
+
+@pytest.mark.slow
+def test_preempt_composed_prefix_spec_pipeline():
+    """The everything-composed arm: preemption under prefix splicing +
+    speculation + depth-2 pipelining stays token-exact to the same
+    composed engine run without contention. The swap parks the spec
+    history leaves, the pipeline drains before the swap captures state,
+    and a victim decoding from a spliced prefix releases its donor
+    segment (swap-in re-splices from the parked copy)."""
+    model, params = _make()
+    kw = dict(prefix_cache_bytes=16 * 1024 * 1024, speculative_k=2,
+              pipeline_depth=2)
+    shared = _prompt(7400, 12)
+    lo_prompt = shared + _prompt(7401, 2)
+    hi_prompt = shared + _prompt(7402, 4)
+
+    engine = ServeEngine(model, params, n_slots=1, tokens_per_launch=8,
+                         priority_classes=2, **kw)
+    # warm the prefix cache so the victim decodes from a splice
+    engine.submit(Request(prompt=shared, max_new_tokens=2, seed=9,
+                          priority=1))
+    engine.run_until_idle()
+    lo_id = engine.submit(Request(prompt=lo_prompt, max_new_tokens=17,
+                                  seed=0, priority=1))
+    engine.step()
+    hi_id = engine.submit(Request(prompt=hi_prompt, max_new_tokens=6,
+                                  seed=1, priority=0))
+    done = {c.request_id: c for c in engine.run_until_idle()}
+    assert engine.n_swaps_out >= 1
+    assert engine.n_swaps_out == engine.n_swaps_in
+
+    ref = ServeEngine(model, params, n_slots=1, tokens_per_launch=8,
+                      priority_classes=2, **kw)
+    ref.submit(Request(prompt=shared, max_new_tokens=2, seed=9,
+                       priority=1))
+    ref.run_until_idle()
+    ref.submit(Request(prompt=lo_prompt, max_new_tokens=17, seed=0,
+                       priority=1))
+    (ref_lo,) = ref.run_until_idle()
+    ref.submit(Request(prompt=hi_prompt, max_new_tokens=6, seed=1,
+                       priority=0))
+    (ref_hi,) = ref.run_until_idle()
+    assert ref.n_swaps_out == 0  # sequential: never contended
+    assert done[lo_id].tokens == ref_lo.tokens
+    assert done[hi_id].tokens == ref_hi.tokens
